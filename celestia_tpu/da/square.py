@@ -1,0 +1,360 @@
+"""Deterministic data-square construction (build for proposers, construct for
+validators).
+
+Behavioral parity with go-square's ``square.Build`` / ``square.Construct`` as
+used at /root/reference/app/prepare_proposal.go:54 and
+app/process_proposal.go:121, following the layout rules of
+specs/src/specs/data_square_layout.md and ADR-020 (deterministic square
+construction):
+
+* shares ordered by namespace: TX ns < PFB ns < primary-reserved padding <
+  user blobs (ns-sorted) < tail padding;
+* blobs start at a multiple of their subtree width (non-interactive default
+  rules, ADR-013), with namespace padding in the gaps;
+* the square is the smallest power-of-two size that fits, capped by
+  ``max_square_size``; Build drops overflowing txs, Construct errors.
+
+The layout is square-size independent (subtree width depends only on blob
+length), so placement indexes are stable across the fit search.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from celestia_tpu.appconsts import (
+    DEFAULT_SQUARE_SIZE_UPPER_BOUND,
+    DEFAULT_SUBTREE_ROOT_THRESHOLD,
+    SUPPORTED_SHARE_VERSIONS,
+    round_up_power_of_two,
+)
+from celestia_tpu.da.blob import (
+    Blob,
+    BlobTx,
+    IndexWrapper,
+    unmarshal_blob_tx,
+)
+from celestia_tpu.da.namespace import (
+    Namespace,
+    PAY_FOR_BLOB_NAMESPACE,
+    TRANSACTION_NAMESPACE,
+)
+from celestia_tpu.da.shares import (
+    Share,
+    namespace_padding_shares,
+    parse_compact_shares,
+    parse_sparse_shares,
+    reserved_padding_shares,
+    shares_to_array,
+    split_blob_into_shares,
+    split_txs_into_shares,
+    tail_padding_shares,
+)
+
+
+def min_square_size(share_count: int) -> int:
+    """Smallest power-of-two width whose square holds ``share_count`` shares."""
+    if share_count <= 1:
+        return 1
+    ceil_sqrt = math.isqrt(share_count - 1) + 1
+    return round_up_power_of_two(ceil_sqrt)
+
+
+def subtree_width(share_count: int, threshold: int = DEFAULT_SUBTREE_ROOT_THRESHOLD) -> int:
+    """Width of the subtree-root mountains for a blob (ADR-013).
+
+    min(RoundUpPowerOfTwo(ceil(n / threshold)), MinSquareSize(n)).
+    """
+    q = -(-share_count // threshold)
+    return min(round_up_power_of_two(q), min_square_size(share_count))
+
+
+def next_share_index(cursor: int, blob_share_len: int, threshold: int = DEFAULT_SUBTREE_ROOT_THRESHOLD) -> int:
+    """First aligned index >= cursor where a blob may start."""
+    width = subtree_width(blob_share_len, threshold)
+    return -(-cursor // width) * width
+
+
+@dataclass(frozen=True)
+class Square:
+    """An original (unextended) data square of k*k shares, row-major."""
+
+    shares: Tuple[Share, ...]
+    size: int  # width k
+
+    def __post_init__(self):
+        if len(self.shares) != self.size * self.size:
+            raise ValueError(
+                f"square size {self.size} needs {self.size**2} shares, got {len(self.shares)}"
+            )
+
+    def to_array(self) -> np.ndarray:
+        """uint8[k*k, 512] for the device extension pipeline."""
+        return shares_to_array(self.shares)
+
+    def is_empty(self) -> bool:
+        return self.size == 1 and self.shares[0].namespace.is_padding()
+
+
+@dataclass
+class _PlacedBlob:
+    blob: Blob
+    order: int  # position in priority (input) order — stable sort key
+    start: int = -1
+
+
+def validate_blob_tx_layout(blob_tx: BlobTx) -> None:
+    """Layout-level BlobTx validity: namespaces usable, versions supported,
+    data non-empty.  The proposer drops violators; the validator rejects the
+    proposal (x/blob/types/blob_tx.go ValidateBlobTx parity, layout subset)."""
+    if not blob_tx.blobs:
+        raise ValueError("blob tx carries no blobs")
+    for b in blob_tx.blobs:
+        b.namespace.validate_for_blob()
+        if b.share_version not in SUPPORTED_SHARE_VERSIONS:
+            raise ValueError(f"unsupported share version {b.share_version}")
+        if len(b.data) == 0:
+            raise ValueError("blob data must be non-empty")
+
+
+@dataclass
+class Builder:
+    """Incremental square builder with fit checking.
+
+    Mirrors go-square's Builder: txs and blob-txs are appended in priority
+    order; ``export`` lays out the final square and returns the block tx list
+    (normal txs raw, PFB txs wrapped as :class:`IndexWrapper`).
+
+    ``fits`` is O(1) in the common case: exact running compact-share counts
+    plus lower/upper bounds on blob placement (upper bound counts each blob's
+    worst-case alignment gap of subtree_width-1); the exact O(n) layout only
+    runs when the bounds disagree about fitting, and is memoized by revision
+    for reuse in ``export``.
+    """
+
+    max_square_size: int = DEFAULT_SQUARE_SIZE_UPPER_BOUND
+    subtree_root_threshold: int = DEFAULT_SUBTREE_ROOT_THRESHOLD
+    txs: List[bytes] = field(default_factory=list)
+    pfb_txs: List[bytes] = field(default_factory=list)  # unwrapped PFB tx bytes
+    pfb_blob_counts: List[int] = field(default_factory=list)
+    blobs: List[_PlacedBlob] = field(default_factory=list)
+    # running byte totals of the two compact sequences (varint-delimited units)
+    _tx_seq_len: int = 0
+    _pfb_seq_len: int = 0
+    # blob share totals: exact sum and worst-case alignment waste
+    _blob_shares: int = 0
+    _blob_waste_bound: int = 0
+    _revision: int = 0
+    _layout_cache: Optional[Tuple[int, Tuple[int, List[_PlacedBlob], int, int]]] = None
+
+    @staticmethod
+    def _unit_len(tx_len: int) -> int:
+        from celestia_tpu.da.shares import _varint
+
+        return len(_varint(tx_len)) + tx_len
+
+    @staticmethod
+    def _compact_shares_for_len(seq_len: int) -> int:
+        from celestia_tpu.appconsts import (
+            CONTINUATION_COMPACT_SHARE_CONTENT_SIZE,
+            FIRST_COMPACT_SHARE_CONTENT_SIZE,
+        )
+
+        if seq_len == 0:
+            return 0
+        if seq_len <= FIRST_COMPACT_SHARE_CONTENT_SIZE:
+            return 1
+        rem = seq_len - FIRST_COMPACT_SHARE_CONTENT_SIZE
+        return 1 + -(-rem // CONTINUATION_COMPACT_SHARE_CONTENT_SIZE)
+
+    def _layout(self) -> Tuple[int, List[_PlacedBlob], int, int]:
+        """Exact layout: (total shares used, placed blobs, n_tx, n_pfb)."""
+        if self._layout_cache is not None and self._layout_cache[0] == self._revision:
+            return self._layout_cache[1]
+        n_tx = self._compact_shares_for_len(self._tx_seq_len)
+        n_pfb = self._compact_shares_for_len(self._pfb_seq_len)
+        cursor = n_tx + n_pfb
+        placed = sorted(self.blobs, key=lambda p: (p.blob.namespace.raw, p.order))
+        out: List[_PlacedBlob] = []
+        for p in placed:
+            ln = p.blob.shares_needed()
+            start = next_share_index(cursor, ln, self.subtree_root_threshold)
+            out.append(_PlacedBlob(p.blob, p.order, start))
+            cursor = start + ln
+        result = (cursor, out, n_tx, n_pfb)
+        self._layout_cache = (self._revision, result)
+        return result
+
+    def current_size(self) -> int:
+        total, _, _, _ = self._layout()
+        return min_square_size(max(total, 1))
+
+    def fits(self) -> bool:
+        max_shares = self.max_square_size * self.max_square_size
+        reserved = self._compact_shares_for_len(
+            self._tx_seq_len
+        ) + self._compact_shares_for_len(self._pfb_seq_len)
+        lower = reserved + self._blob_shares
+        if lower > max_shares:
+            return False
+        upper = reserved + self._blob_shares + self._blob_waste_bound
+        if upper <= max_shares:
+            return True
+        total, _, _, _ = self._layout()
+        return total <= max_shares
+
+    def append_tx(self, tx: bytes) -> bool:
+        """Tentatively add a normal tx; False (and rollback) if it overflows."""
+        self.txs.append(tx)
+        self._tx_seq_len += self._unit_len(len(tx))
+        self._revision += 1
+        if not self.fits():
+            self.txs.pop()
+            self._tx_seq_len -= self._unit_len(len(tx))
+            self._revision += 1
+            return False
+        return True
+
+    def append_blob_tx(self, blob_tx: BlobTx) -> bool:
+        """Tentatively add a BlobTx; False (and rollback) if it overflows.
+
+        Raises ValueError on an invalid BlobTx (caller decides drop vs reject).
+        """
+        validate_blob_tx_layout(blob_tx)
+        order0 = len(self.blobs)
+        wrapper_len = IndexWrapper.marshalled_size(len(blob_tx.tx), len(blob_tx.blobs))
+        d_pfb = self._unit_len(wrapper_len)
+        d_shares = 0
+        d_waste = 0
+        for b in blob_tx.blobs:
+            n = b.shares_needed()
+            d_shares += n
+            d_waste += subtree_width(n, self.subtree_root_threshold) - 1
+        self.pfb_txs.append(blob_tx.tx)
+        self.pfb_blob_counts.append(len(blob_tx.blobs))
+        for b in blob_tx.blobs:
+            self.blobs.append(_PlacedBlob(b, len(self.blobs)))
+        self._pfb_seq_len += d_pfb
+        self._blob_shares += d_shares
+        self._blob_waste_bound += d_waste
+        self._revision += 1
+        if not self.fits():
+            self.pfb_txs.pop()
+            self.pfb_blob_counts.pop()
+            del self.blobs[order0:]
+            self._pfb_seq_len -= d_pfb
+            self._blob_shares -= d_shares
+            self._blob_waste_bound -= d_waste
+            self._revision += 1
+            return False
+        return True
+
+    def export(self) -> Tuple[Square, List[bytes]]:
+        """Lay out the final square; returns (square, block tx list)."""
+        total, placed, n_tx, n_pfb = self._layout()
+        size = min_square_size(max(total, 1))
+        if size > self.max_square_size:
+            raise ValueError(
+                f"square overflow: need size {size} > max {self.max_square_size}"
+            )
+
+        # Share indexes per PFB, in pfb_txs order.
+        start_by_order = {p.order: p.start for p in placed}
+        wrappers: List[IndexWrapper] = []
+        order = 0
+        for tx, n_blobs in zip(self.pfb_txs, self.pfb_blob_counts):
+            idxs = tuple(start_by_order[order + i] for i in range(n_blobs))
+            wrappers.append(IndexWrapper(tx, idxs))
+            order += n_blobs
+
+        shares: List[Share] = []
+        if self.txs:
+            shares.extend(split_txs_into_shares(TRANSACTION_NAMESPACE, self.txs))
+        if wrappers:
+            shares.extend(
+                split_txs_into_shares(
+                    PAY_FOR_BLOB_NAMESPACE, [w.marshal() for w in wrappers]
+                )
+            )
+        assert len(shares) == n_tx + n_pfb, "compact share count drifted from layout"
+
+        cursor = len(shares)
+        prev_ns: Optional[Namespace] = None
+        for p in placed:
+            if p.start > cursor:
+                pad_ns = prev_ns
+                if pad_ns is None:
+                    shares.extend(reserved_padding_shares(p.start - cursor))
+                else:
+                    shares.extend(namespace_padding_shares(pad_ns, p.start - cursor))
+            blob_shares = split_blob_into_shares(
+                p.blob.namespace, p.blob.data, p.blob.share_version
+            )
+            shares.extend(blob_shares)
+            cursor = p.start + len(blob_shares)
+            prev_ns = p.blob.namespace
+        if len(shares) < size * size:
+            shares.extend(tail_padding_shares(size * size - len(shares)))
+
+        block_txs = list(self.txs) + [w.marshal() for w in wrappers]
+        return Square(tuple(shares), size), block_txs
+
+
+def build(
+    txs: Sequence[bytes],
+    max_square_size: int = DEFAULT_SQUARE_SIZE_UPPER_BOUND,
+    subtree_root_threshold: int = DEFAULT_SUBTREE_ROOT_THRESHOLD,
+) -> Tuple[Square, List[bytes]]:
+    """Proposer path (app/prepare_proposal.go:54): lay out as many priority-
+    ordered txs as fit; overflowing txs are dropped, never reordered."""
+    b = Builder(max_square_size, subtree_root_threshold)
+    for raw in txs:
+        btx = unmarshal_blob_tx(raw)
+        if btx is not None:
+            try:
+                b.append_blob_tx(btx)
+            except ValueError:
+                continue  # invalid BlobTx: proposer drops it
+        else:
+            b.append_tx(raw)
+    return b.export()
+
+
+def construct(
+    txs: Sequence[bytes],
+    max_square_size: int = DEFAULT_SQUARE_SIZE_UPPER_BOUND,
+    subtree_root_threshold: int = DEFAULT_SUBTREE_ROOT_THRESHOLD,
+) -> Tuple[Square, List[bytes]]:
+    """Validator path (app/process_proposal.go:121): re-lay out the proposed
+    txs strictly; any overflow is an error (proposal rejected)."""
+    b = Builder(max_square_size, subtree_root_threshold)
+    for raw in txs:
+        btx = unmarshal_blob_tx(raw)
+        if btx is not None:
+            ok = b.append_blob_tx(btx)
+        else:
+            ok = b.append_tx(raw)
+        if not ok:
+            raise ValueError("square construction overflow: proposal exceeds max square size")
+    return b.export()
+
+
+def extract_txs_and_blobs(
+    square: Square,
+) -> Tuple[List[bytes], List[bytes], List[Tuple[Namespace, bytes]]]:
+    """Parse a square back into (normal txs, wrapped PFB txs, blobs)."""
+    tx_shares = [s for s in square.shares if s.namespace.raw == TRANSACTION_NAMESPACE.raw]
+    pfb_shares = [s for s in square.shares if s.namespace.raw == PAY_FOR_BLOB_NAMESPACE.raw]
+    blob_shares = [
+        s
+        for s in square.shares
+        if s.namespace.is_usable_by_users()
+    ]
+    txs = parse_compact_shares(tx_shares) if tx_shares else []
+    pfbs = parse_compact_shares(pfb_shares) if pfb_shares else []
+    blobs = parse_sparse_shares(blob_shares)
+    return txs, pfbs, blobs

@@ -1,0 +1,145 @@
+"""Share-layer tests: namespaces, sparse/compact share round-trips, padding.
+
+Mirrors the unit-test tier of the reference (SURVEY.md §4 tier 1); golden
+values follow specs/src/specs/shares.md (e.g. reserved-bytes offset 38 on the
+first compact share).
+"""
+
+import numpy as np
+import pytest
+
+from celestia_tpu.appconsts import (
+    CONTINUATION_SPARSE_SHARE_CONTENT_SIZE,
+    FIRST_SPARSE_SHARE_CONTENT_SIZE,
+    SHARE_SIZE,
+)
+from celestia_tpu.da import namespace as ns
+from celestia_tpu.da import shares as sh
+from celestia_tpu.da.blob import Blob, BlobTx, IndexWrapper, unmarshal_blob_tx, unmarshal_index_wrapper
+
+
+def test_share_layout_constants():
+    assert FIRST_SPARSE_SHARE_CONTENT_SIZE == 478
+    assert CONTINUATION_SPARSE_SHARE_CONTENT_SIZE == 482
+    assert sh.FIRST_COMPACT_SHARE_CONTENT_SIZE == 474
+    assert sh.CONTINUATION_COMPACT_SHARE_CONTENT_SIZE == 478
+
+
+def test_reserved_namespaces_ordering():
+    assert ns.TRANSACTION_NAMESPACE.raw < ns.PAY_FOR_BLOB_NAMESPACE.raw
+    assert ns.PAY_FOR_BLOB_NAMESPACE.raw < ns.PRIMARY_RESERVED_PADDING_NAMESPACE.raw
+    assert ns.TAIL_PADDING_NAMESPACE.raw < ns.PARITY_SHARE_NAMESPACE.raw
+    assert ns.PARITY_SHARE_NAMESPACE.raw == b"\xff" * 29
+    assert ns.TRANSACTION_NAMESPACE.is_primary_reserved()
+    assert ns.PARITY_SHARE_NAMESPACE.is_secondary_reserved()
+    user = ns.Namespace.v0(b"myrollup")
+    assert user.is_usable_by_users()
+    user.validate_for_blob()
+
+
+def test_v0_namespace_validation():
+    with pytest.raises(ValueError):
+        ns.Namespace.v0(b"x" * 11)
+    bad = ns.Namespace.from_version_id(0, b"\x01" + b"\x00" * 27)
+    with pytest.raises(ValueError):
+        bad.validate_for_blob()
+    with pytest.raises(ValueError):
+        ns.TRANSACTION_NAMESPACE.validate_for_blob()
+
+
+def test_single_share_blob_roundtrip():
+    namespace = ns.Namespace.v0(b"test")
+    data = b"hello celestia tpu"
+    shares = sh.split_blob_into_shares(namespace, data)
+    assert len(shares) == 1
+    s = shares[0]
+    assert s.namespace == namespace
+    assert s.is_sequence_start
+    assert s.version == 0
+    assert s.sequence_len() == len(data)
+    parsed = sh.parse_sparse_shares(shares)
+    assert parsed == [(namespace, data)]
+
+
+@pytest.mark.parametrize("n_bytes", [1, 478, 479, 960, 961, 5000, 100_000])
+def test_multi_share_blob_roundtrip(n_bytes):
+    rng = np.random.default_rng(n_bytes)
+    namespace = ns.Namespace.v0(b"blobns")
+    data = rng.integers(0, 256, n_bytes, dtype=np.uint8).tobytes()
+    shares = sh.split_blob_into_shares(namespace, data)
+    assert len(shares) == sh.sparse_shares_needed(n_bytes)
+    for i, s in enumerate(shares):
+        assert s.is_sequence_start == (i == 0)
+        assert len(s.raw) == SHARE_SIZE
+    parsed = sh.parse_sparse_shares(shares)
+    assert parsed == [(namespace, data)]
+
+
+def test_compact_shares_reserved_bytes_golden():
+    # First unit starts right after ns(29)+info(1)+seqlen(4)+reserved(4) = 38
+    # (specs/src/specs/shares.md figure 3).
+    txs = [b"a" * 100]
+    shares = sh.split_txs_into_shares(ns.TRANSACTION_NAMESPACE, txs)
+    assert len(shares) == 1
+    assert shares[0].reserved_bytes() == 38
+    assert sh.parse_compact_shares(shares) == txs
+
+
+def test_compact_shares_multi_tx_roundtrip():
+    rng = np.random.default_rng(7)
+    txs = [
+        rng.integers(0, 256, int(n), dtype=np.uint8).tobytes()
+        for n in rng.integers(1, 2000, 25)
+    ]
+    shares = sh.split_txs_into_shares(ns.TRANSACTION_NAMESPACE, txs)
+    assert len(shares) == sh.compact_shares_needed(txs)
+    assert sh.parse_compact_shares(shares) == txs
+    # every share that contains a unit start advertises a plausible offset
+    for s in shares:
+        r = s.reserved_bytes()
+        assert r == 0 or 34 <= r < SHARE_SIZE
+
+
+def test_compact_share_reserved_bytes_no_unit_start():
+    # One tx spanning many shares: middle shares have reserved = 0.
+    txs = [b"z" * 3000]
+    shares = sh.split_txs_into_shares(ns.TRANSACTION_NAMESPACE, txs)
+    assert len(shares) > 2
+    assert shares[0].reserved_bytes() == 38
+    assert all(s.reserved_bytes() == 0 for s in shares[1:])
+    assert sh.parse_compact_shares(shares) == txs
+
+
+def test_padding_shares():
+    p = sh.padding_share(ns.TAIL_PADDING_NAMESPACE)
+    assert p.is_sequence_start and p.sequence_len() == 0
+    assert p.raw[34:] == b"\x00" * (SHARE_SIZE - 34)
+    blobs = sh.parse_sparse_shares([p])
+    assert blobs == []
+
+
+def test_shares_array_roundtrip():
+    namespace = ns.Namespace.v0(b"arr")
+    shares = sh.split_blob_into_shares(namespace, b"x" * 1000)
+    arr = sh.shares_to_array(shares)
+    assert arr.shape == (len(shares), SHARE_SIZE) and arr.dtype == np.uint8
+    back = sh.array_to_shares(arr)
+    assert back == shares
+
+
+def test_blob_tx_roundtrip():
+    b1 = Blob(ns.Namespace.v0(b"one"), b"data-1")
+    b2 = Blob(ns.Namespace.v0(b"two"), b"data-2" * 100)
+    btx = BlobTx(tx=b"signed-pfb-bytes", blobs=(b1, b2))
+    raw = btx.marshal()
+    back = unmarshal_blob_tx(raw)
+    assert back == btx
+    assert unmarshal_blob_tx(b"not a blob tx") is None
+
+
+def test_index_wrapper_roundtrip():
+    w = IndexWrapper(tx=b"pfb-tx", share_indexes=(4, 130))
+    raw = w.marshal()
+    assert len(raw) == IndexWrapper.marshalled_size(len(w.tx), 2)
+    assert unmarshal_index_wrapper(raw) == w
+    assert unmarshal_index_wrapper(b"junk") is None

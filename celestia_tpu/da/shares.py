@@ -227,6 +227,8 @@ def split_txs_into_shares(namespace: Namespace, txs: Sequence[bytes]) -> List[Sh
     """
     units = b"".join(_varint(len(tx)) + tx for tx in txs)
     seq_len = len(units)
+    if seq_len == 0:
+        return []  # consistent with compact_shares_needed([]) == 0
 
     # Content capacity per share.
     caps = [FIRST_COMPACT_SHARE_CONTENT_SIZE]

@@ -142,6 +142,9 @@ class Builder:
     pfb_txs: List[bytes] = field(default_factory=list)  # unwrapped PFB tx bytes
     pfb_blob_counts: List[int] = field(default_factory=list)
     blobs: List[_PlacedBlob] = field(default_factory=list)
+    # kept original raw txs (normal raws + BlobTx envelopes) in append order —
+    # this is the block tx list validators re-construct the square from
+    block_txs: List[bytes] = field(default_factory=list)
     # running byte totals of the two compact sequences (varint-delimited units)
     _tx_seq_len: int = 0
     _pfb_seq_len: int = 0
@@ -217,12 +220,15 @@ class Builder:
             self._tx_seq_len -= self._unit_len(len(tx))
             self._revision += 1
             return False
+        self.block_txs.append(tx)
         return True
 
-    def append_blob_tx(self, blob_tx: BlobTx) -> bool:
+    def append_blob_tx(self, blob_tx: BlobTx, raw: Optional[bytes] = None) -> bool:
         """Tentatively add a BlobTx; False (and rollback) if it overflows.
 
         Raises ValueError on an invalid BlobTx (caller decides drop vs reject).
+        ``raw`` is the marshalled envelope recorded in the block tx list
+        (re-marshalled if omitted).
         """
         validate_blob_tx_layout(blob_tx)
         order0 = len(self.blobs)
@@ -251,10 +257,19 @@ class Builder:
             self._blob_waste_bound -= d_waste
             self._revision += 1
             return False
+        self.block_txs.append(raw if raw is not None else blob_tx.marshal())
         return True
 
-    def export(self) -> Tuple[Square, List[bytes]]:
-        """Lay out the final square; returns (square, block tx list)."""
+    def export(self) -> Tuple[Square, List[bytes], List[IndexWrapper]]:
+        """Lay out the final square.
+
+        Returns ``(square, block_txs, wrappers)``: the block tx list is the
+        kept *original* raw txs (normal txs and BlobTx envelopes, priority
+        order) — feeding it back through :func:`construct` reproduces the
+        square byte-for-byte on the validator side; ``wrappers`` are the
+        share-index-annotated PFB txs as written into the square's
+        PAY_FOR_BLOB namespace (used at execution time).
+        """
         total, placed, n_tx, n_pfb = self._layout()
         size = min_square_size(max(total, 1))
         if size > self.max_square_size:
@@ -300,15 +315,14 @@ class Builder:
         if len(shares) < size * size:
             shares.extend(tail_padding_shares(size * size - len(shares)))
 
-        block_txs = list(self.txs) + [w.marshal() for w in wrappers]
-        return Square(tuple(shares), size), block_txs
+        return Square(tuple(shares), size), list(self.block_txs), wrappers
 
 
 def build(
     txs: Sequence[bytes],
     max_square_size: int = DEFAULT_SQUARE_SIZE_UPPER_BOUND,
     subtree_root_threshold: int = DEFAULT_SUBTREE_ROOT_THRESHOLD,
-) -> Tuple[Square, List[bytes]]:
+) -> Tuple[Square, List[bytes], List[IndexWrapper]]:
     """Proposer path (app/prepare_proposal.go:54): lay out as many priority-
     ordered txs as fit; overflowing txs are dropped, never reordered."""
     b = Builder(max_square_size, subtree_root_threshold)
@@ -316,7 +330,7 @@ def build(
         btx = unmarshal_blob_tx(raw)
         if btx is not None:
             try:
-                b.append_blob_tx(btx)
+                b.append_blob_tx(btx, raw=raw)
             except ValueError:
                 continue  # invalid BlobTx: proposer drops it
         else:
@@ -328,14 +342,14 @@ def construct(
     txs: Sequence[bytes],
     max_square_size: int = DEFAULT_SQUARE_SIZE_UPPER_BOUND,
     subtree_root_threshold: int = DEFAULT_SUBTREE_ROOT_THRESHOLD,
-) -> Tuple[Square, List[bytes]]:
+) -> Tuple[Square, List[bytes], List[IndexWrapper]]:
     """Validator path (app/process_proposal.go:121): re-lay out the proposed
     txs strictly; any overflow is an error (proposal rejected)."""
     b = Builder(max_square_size, subtree_root_threshold)
     for raw in txs:
         btx = unmarshal_blob_tx(raw)
         if btx is not None:
-            ok = b.append_blob_tx(btx)
+            ok = b.append_blob_tx(btx, raw=raw)
         else:
             ok = b.append_tx(raw)
         if not ok:

@@ -1,0 +1,187 @@
+"""GF(2^8) arithmetic and Reed-Solomon matrices (host side, numpy).
+
+This is the TPU build's equivalent of the reference's erasure codec
+(rsmt2d.Codec backed by klauspost/reedsolomon "Leopard", selected at
+/root/reference/pkg/appconsts/global_consts.go:91-92).  Instead of an
+O(n log n) FFT codec with SIMD assembly, we use a systematic
+Lagrange-evaluation RS code whose encode/decode are *matrices* over GF(256),
+lowered to GF(2) bit-matrices so the device can run them as plain integer
+matmuls on the MXU (see ops/rs.py).  For the protocol's k <= 128 this is
+exact, deterministic, and maps perfectly onto the 128x128 systolic array.
+
+Code definition: a row of k data shares is a polynomial sampled at field
+points 0..k-1; parity shares are its evaluations at points k..2k-1.  Any k
+of the 2k points reconstruct the rest (Lagrange interpolation) — the same
+25%-withholding recovery property rsmt2d relies on for DAS.
+
+Field: GF(2^8) with primitive polynomial 0x11D (x^8+x^4+x^3+x^2+1).
+All matrices here are cached per square size; everything downstream is
+bit-exact across backends because the device path is integer-only.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+_PRIM_POLY = 0x11D
+_ORDER = 255
+
+# --- log/antilog tables -----------------------------------------------------
+
+
+def _build_tables():
+    exp = np.zeros(512, dtype=np.int32)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(_ORDER):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= _PRIM_POLY
+    for i in range(_ORDER, 512):
+        exp[i] = exp[i - _ORDER]
+    return exp, log
+
+
+GF_EXP, GF_LOG = _build_tables()
+
+
+def gf_mul(a, b):
+    """Element-wise GF(256) multiply over numpy uint8 arrays (or scalars)."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    out = GF_EXP[(GF_LOG[a.astype(np.int32)] + GF_LOG[b.astype(np.int32)]) % _ORDER]
+    out = np.where((a == 0) | (b == 0), 0, out)
+    return out.astype(np.uint8)
+
+
+def gf_inv(a):
+    a = np.asarray(a, dtype=np.uint8)
+    if np.any(a == 0):
+        raise ZeroDivisionError("GF(256) inverse of zero")
+    return GF_EXP[(_ORDER - GF_LOG[a.astype(np.int32)]) % _ORDER].astype(np.uint8)
+
+
+def gf_div(a, b):
+    return gf_mul(a, gf_inv(b))
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """GF(256) matrix product (host reference; small matrices only)."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    out = np.zeros((a.shape[0], b.shape[1]), dtype=np.uint8)
+    for j in range(a.shape[1]):
+        prod = gf_mul(a[:, j : j + 1], b[j : j + 1, :])
+        out ^= prod
+    return out
+
+
+# --- Lagrange evaluation matrices -------------------------------------------
+
+
+def lagrange_matrix(src_points: np.ndarray, dst_points: np.ndarray) -> np.ndarray:
+    """M[i, j] such that f(dst_i) = sum_j M[i,j] * f(src_j) in GF(256).
+
+    src_points must be distinct; dst may overlap src (rows become unit rows).
+    Vectorized via log-domain products.
+    """
+    src = np.asarray(src_points, dtype=np.uint8)
+    dst = np.asarray(dst_points, dtype=np.uint8)
+    k = len(src)
+    if len(np.unique(src)) != k:
+        raise ValueError("source points must be distinct")
+    # denom_j = prod_{m != j} (src_j ^ src_m)
+    diff_ss = src[None, :] ^ src[:, None]  # [j, m]
+    np.fill_diagonal(diff_ss, 1)  # neutral in the product
+    denom_log = GF_LOG[diff_ss.astype(np.int32)].sum(axis=1) % _ORDER  # [j]
+    # num_{i,j} = prod_{m != j} (dst_i ^ src_m)
+    diff_ds = dst[:, None] ^ src[None, :]  # [i, m]
+    zero_mask = diff_ds == 0  # dst_i == src_m
+    safe = np.where(zero_mask, 1, diff_ds)
+    log_all = GF_LOG[safe.astype(np.int32)]
+    total_log = log_all.sum(axis=1)  # [i] — includes m == j term
+    n_zeros = zero_mask.sum(axis=1)  # [i]
+    M = np.zeros((len(dst), k), dtype=np.uint8)
+    for i in range(len(dst)):
+        if n_zeros[i] > 0:
+            # dst_i coincides with some src point: unit row.
+            j = int(np.nonzero(zero_mask[i])[0][0])
+            M[i, j] = 1
+            continue
+        num_log = (total_log[i] - log_all[i]) % _ORDER  # [j]
+        M[i] = GF_EXP[(num_log - denom_log) % _ORDER]
+    return M
+
+
+@lru_cache(maxsize=None)
+def encode_matrix(k: int) -> np.ndarray:
+    """E (k x k): parity shares k..2k-1 from data shares 0..k-1."""
+    if not 1 <= k <= 128:
+        raise ValueError(f"square size k must be in [1, 128], got {k}")
+    pts = np.arange(2 * k, dtype=np.uint8)
+    return lagrange_matrix(pts[:k], pts[k:])
+
+
+def decode_matrix(known_points: np.ndarray, k: int) -> np.ndarray:
+    """D (2k x k): all 2k shares from the k known-point shares."""
+    known = np.asarray(known_points, dtype=np.uint8)
+    if len(known) != k:
+        raise ValueError(f"need exactly {k} known points, got {len(known)}")
+    return lagrange_matrix(known, np.arange(2 * k, dtype=np.uint8))
+
+
+# --- GF(2) bit-expansion ----------------------------------------------------
+#
+# Multiplication by a constant c in GF(2^8) is GF(2)-linear on the bits of the
+# operand: bit s of (c*b) = XOR_t M_c[s,t]*b_t with M_c[s,t] = bit s of
+# (c * 2^t).  A GF(256) matrix A (m x n) therefore lifts to a binary matrix
+# A_bits (8m x 8n) and "y = A x over GF(256)" becomes
+# "y_bits = A_bits @ x_bits mod 2" — an integer matmul the MXU executes
+# natively (int8 inputs, int32 accumulation), with the mod-2 as a cheap
+# elementwise mask.
+
+
+def bit_expand_matrix(A: np.ndarray) -> np.ndarray:
+    """Lift a GF(256) matrix (m x n) to its GF(2) form (8m x 8n), int8 0/1.
+
+    Row index i*8+s = output bit s of GF-row i; column index j*8+t = input
+    bit t of GF-column j.
+    """
+    A = np.asarray(A, dtype=np.uint8)
+    m, n = A.shape
+    powers = (np.uint8(1) << np.arange(8, dtype=np.uint8))  # 2^t
+    # prod[m_i, n_j, t] = A[i,j] * 2^t in GF(256)
+    prod = gf_mul(A[:, :, None], powers[None, None, :])  # (m, n, 8) uint8
+    # bits[s] of prod -> out[(i,s),(j,t)]
+    s_idx = np.arange(8, dtype=np.uint8)
+    bits = (prod[:, :, None, :] >> s_idx[None, None, :, None]) & 1  # (m, n, s, t)
+    out = bits.transpose(0, 2, 1, 3).reshape(8 * m, 8 * n)
+    return out.astype(np.int8)
+
+
+@lru_cache(maxsize=None)
+def encode_matrix_bits(k: int) -> np.ndarray:
+    """Bit-expanded encode matrix (8k x 8k), int8 0/1 — the MXU operand."""
+    return bit_expand_matrix(encode_matrix(k))
+
+
+# --- Host reference encode (for bit-exactness tests) ------------------------
+
+
+def encode_shares_ref(data: np.ndarray) -> np.ndarray:
+    """Reference row-encode: data (k, B) uint8 -> parity (k, B) uint8.
+
+    Direct table-lookup GF matmul; the device path in ops/rs.py must match
+    this bit-for-bit.
+    """
+    data = np.asarray(data, dtype=np.uint8)
+    k = data.shape[0]
+    E = encode_matrix(k)
+    out = np.zeros_like(data)
+    for j in range(k):
+        out ^= gf_mul(E[:, j : j + 1], data[j : j + 1, :])
+    return out

@@ -1,0 +1,209 @@
+"""Device-side 2D Reed-Solomon extension and repair (JAX, MXU matmuls).
+
+TPU-native equivalent of ``rsmt2d.ComputeExtendedDataSquare`` /
+``rsmt2d.Repair`` as invoked by the reference at
+/root/reference/pkg/da/data_availability_header.go:65-75 (encode) and its DAS
+reconstruction surface (SURVEY.md §2.2).  Everything is integer arithmetic —
+bit-exact across TPU/CPU backends and compiler versions, which is a consensus
+-safety requirement (SURVEY.md §2.3 "determinism").
+
+Representation: a square is ``uint8[k, k, 512]`` (row, column, byte).  GF(256)
+linear maps are lifted to GF(2) bit-matrices (ops/gf256.py): shares are
+unpacked to bit-planes, multiplied with an int8 0/1 matrix on the MXU with
+int32 accumulation, reduced mod 2, and packed back to bytes.  The extension
+is three batched matmuls (row parity, column parity, diagonal parity) fused
+under one ``jit``.
+
+Quadrant layout of the extended square (2k x 2k):
+
+    Q0 | Q1        Q0 = original, Q1 = row parity,
+    -------        Q2 = column parity, Q3 = parity of parity
+    Q2 | Q3        (row- and column-extension commute; tested)
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from celestia_tpu.appconsts import SHARE_SIZE, is_power_of_two
+from celestia_tpu.ops import gf256
+
+
+def unpack_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """uint8[..., n, B] -> int8 bits[..., 8n, B]; bit row j*8+t = bit t of byte row j."""
+    t = jnp.arange(8, dtype=jnp.uint8)
+    bits = (x[..., :, None, :] >> t[None, :, None]) & 1  # (..., n, 8, B)
+    shape = x.shape[:-2] + (8 * x.shape[-2], x.shape[-1])
+    return bits.reshape(shape).astype(jnp.int8)
+
+
+def pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """int bits[..., 8n, B] -> uint8[..., n, B] (inverse of unpack_bits)."""
+    shape = bits.shape[:-2] + (bits.shape[-2] // 8, 8, bits.shape[-1])
+    b = bits.reshape(shape).astype(jnp.int32)
+    t = jnp.arange(8, dtype=jnp.int32)
+    return (b << t[None, :, None]).sum(axis=-2).astype(jnp.uint8)
+
+
+def matmul_gf2(G: jnp.ndarray, bits: jnp.ndarray) -> jnp.ndarray:
+    """(G @ bits) mod 2 with int32 MXU accumulation; operands int8 0/1."""
+    acc = jnp.matmul(G, bits, preferred_element_type=jnp.int32)
+    return (acc & 1).astype(jnp.int8)
+
+
+def _row_parity(square: jnp.ndarray, G: jnp.ndarray) -> jnp.ndarray:
+    """(r, k, B) uint8 -> (r, k, B) uint8 parity of each row."""
+    bits = unpack_bits(square)  # (r, 8k, B)
+    return pack_bits(matmul_gf2(G, bits))
+
+
+def _extend(square: jnp.ndarray, G: jnp.ndarray) -> jnp.ndarray:
+    """Core extension: uint8[k, k, B] -> uint8[2k, 2k, B]."""
+    q0 = square
+    q1 = _row_parity(q0, G)  # row parity
+    q2 = _row_parity(q0.transpose(1, 0, 2), G).transpose(1, 0, 2)  # col parity
+    q3 = _row_parity(q1.transpose(1, 0, 2), G).transpose(1, 0, 2)  # parity of parity
+    top = jnp.concatenate([q0, q1], axis=1)
+    bottom = jnp.concatenate([q2, q3], axis=1)
+    return jnp.concatenate([top, bottom], axis=0)
+
+
+@lru_cache(maxsize=None)
+def _extend_fn(k: int):
+    G = jnp.asarray(gf256.encode_matrix_bits(k))
+    return jax.jit(partial(_extend, G=G))
+
+
+def extend_square(square) -> jnp.ndarray:
+    """Extend an original square uint8[k, k, 512] to its EDS uint8[2k, 2k, 512]."""
+    square = jnp.asarray(square, dtype=jnp.uint8)
+    k = square.shape[0]
+    if square.shape[1] != k or not is_power_of_two(k):
+        raise ValueError(f"square must be (k, k, B) with k a power of two, got {square.shape}")
+    return _extend_fn(k)(square)
+
+
+@lru_cache(maxsize=None)
+def _extend_batched_fn(k: int):
+    G = jnp.asarray(gf256.encode_matrix_bits(k))
+    return jax.jit(jax.vmap(partial(_extend, G=G)))
+
+
+def extend_squares_batched(squares) -> jnp.ndarray:
+    """Extend a batch uint8[n, k, k, 512] -> uint8[n, 2k, 2k, 512]."""
+    squares = jnp.asarray(squares, dtype=jnp.uint8)
+    return _extend_batched_fn(squares.shape[1])(squares)
+
+
+# ---------------------------------------------------------------------------
+# Repair (rsmt2d.Repair parity): iterative row/column reconstruction
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _apply_decode(known: jnp.ndarray, Db: jnp.ndarray) -> jnp.ndarray:
+    """known uint8[n, k, B] + bit decode matrix int8[16k, 8k] -> uint8[n, 2k, B].
+
+    One compiled executable per (n, k, B) shape; the per-availability-mask
+    decode matrix is a runtime argument, so arbitrary withholding patterns
+    never trigger recompilation.
+    """
+    bits = unpack_bits(known)  # (n, 8k, B)
+    out_bits = matmul_gf2(Db, bits)  # (n, 16k, B)
+    return pack_bits(out_bits)  # (n, 2k, B)
+
+
+def decode_axes(rows: np.ndarray, known_points: np.ndarray) -> np.ndarray:
+    """Reconstruct full 2k-long axes from k known positions.
+
+    rows: uint8[n, 2k, B] with valid data at ``known_points`` (k indexes);
+    returns uint8[n, 2k, B] fully populated.
+    """
+    rows = np.asarray(rows, dtype=np.uint8)
+    k = rows.shape[1] // 2
+    known_idx = np.asarray(known_points, dtype=np.int64)
+    if len(known_idx) != k:
+        raise ValueError(f"need exactly {k} known points, got {len(known_idx)}")
+    D = gf256.decode_matrix(known_idx.astype(np.uint8), k)  # (2k, k) GF(256)
+    Db = jnp.asarray(gf256.bit_expand_matrix(D))  # (16k, 8k) int8
+    # pad the batch to a power-of-two bucket to bound compilation count
+    n = rows.shape[0]
+    n_pad = 1 << max(n - 1, 0).bit_length()
+    known = np.zeros((n_pad, k, rows.shape[2]), dtype=np.uint8)
+    known[:n] = rows[:, known_idx, :]
+    out = _apply_decode(jnp.asarray(known), Db)
+    return np.asarray(out)[:n]
+
+
+def repair_square(eds: np.ndarray, available: np.ndarray) -> np.ndarray:
+    """Reconstruct a full EDS from a partial one (rsmt2d.Repair parity).
+
+    eds: uint8[2k, 2k, B] with garbage in unavailable cells;
+    available: bool[2k, 2k] marking cells present.
+    Iteratively solves every row/column with >= k available cells, batching
+    axes that share an availability mask into one device matmul, until the
+    square is complete.  Raises ValueError if reconstruction stalls
+    (insufficient data — fewer than k cells in every incomplete axis).
+    """
+    eds = np.array(eds, dtype=np.uint8, copy=True)
+    avail = np.array(available, dtype=bool, copy=True)
+    n2 = eds.shape[0]
+    k = n2 // 2
+    if eds.shape[:2] != (n2, n2) or avail.shape != (n2, n2):
+        raise ValueError("eds must be (2k, 2k, B) with matching availability mask")
+    # Zero out unavailable cells so "garbage" can't leak through masks.
+    eds[~avail] = 0
+
+    while not avail.all():
+        progress = False
+        for axis in (0, 1):  # rows then columns
+            data = eds if axis == 0 else eds.transpose(1, 0, 2)
+            mask = avail if axis == 0 else avail.T
+            counts = mask.sum(axis=1)
+            solvable = np.nonzero((counts >= k) & (counts < n2))[0]
+            if len(solvable) == 0:
+                continue
+            # Group axes by identical availability mask (typical DAS
+            # withholding patterns produce one or two groups).
+            groups: dict = {}
+            for i in solvable:
+                key = tuple(np.nonzero(mask[i])[0][:k])
+                groups.setdefault(key, []).append(i)
+            for key, idxs in groups.items():
+                rows = data[np.asarray(idxs)]
+                decoded = decode_axes(rows, np.asarray(key))
+                if axis == 0:
+                    eds[np.asarray(idxs)] = decoded
+                    avail[np.asarray(idxs)] = True
+                else:
+                    eds[:, np.asarray(idxs)] = decoded.transpose(1, 0, 2)
+                    avail[:, np.asarray(idxs)] = True
+            progress = True
+        if not progress:
+            raise ValueError(
+                "repair stalled: insufficient available cells to reconstruct"
+            )
+    return eds
+
+
+# ---------------------------------------------------------------------------
+# Host reference (numpy) for bit-exactness tests
+# ---------------------------------------------------------------------------
+
+
+def extend_square_ref(square: np.ndarray) -> np.ndarray:
+    """Pure-numpy reference of extend_square; the device must match exactly."""
+    square = np.asarray(square, dtype=np.uint8)
+    k = square.shape[0]
+    B = square.shape[2]
+    out = np.zeros((2 * k, 2 * k, B), dtype=np.uint8)
+    out[:k, :k] = square
+    for r in range(k):  # row parity
+        out[r, k:] = gf256.encode_shares_ref(square[r])
+    for c in range(2 * k):  # column parity (over the top half)
+        out[k:, c] = gf256.encode_shares_ref(out[:k, c])
+    return out

@@ -1,0 +1,137 @@
+"""Reed-Solomon kernel tests: bit-exactness device-vs-reference, quadrant
+commutativity, repair from partial data (rsmt2d parity, SURVEY.md §2.2)."""
+
+import numpy as np
+import pytest
+
+from celestia_tpu.ops import gf256, rs
+
+
+def test_gf_mul_basics():
+    assert gf256.gf_mul(0, 5) == 0
+    assert gf256.gf_mul(1, 173) == 173
+    assert gf256.gf_mul(2, 0x80) == (0x100 ^ 0x11D) & 0xFF  # x * x^7 reduces
+    a = np.arange(256, dtype=np.uint8)
+    nz = a[1:]
+    assert np.all(gf256.gf_mul(nz, gf256.gf_inv(nz)) == 1)
+
+
+def test_gf_mul_distributes():
+    rng = np.random.default_rng(0)
+    a, b, c = (rng.integers(0, 256, 100, dtype=np.uint8) for _ in range(3))
+    left = gf256.gf_mul(a, b ^ c)
+    right = gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+    assert np.array_equal(left, right)
+
+
+def test_lagrange_identity_rows():
+    # dst overlapping src gives unit rows.
+    src = np.array([0, 1, 2, 3], dtype=np.uint8)
+    M = gf256.lagrange_matrix(src, src)
+    assert np.array_equal(M, np.eye(4, dtype=np.uint8))
+
+
+def test_encode_matrix_k1_is_repetition():
+    E = gf256.encode_matrix(1)
+    assert E.shape == (1, 1) and E[0, 0] == 1
+
+
+def test_bit_expand_matches_gf_mul():
+    rng = np.random.default_rng(1)
+    A = rng.integers(0, 256, (4, 4), dtype=np.uint8)
+    x = rng.integers(0, 256, (4, 16), dtype=np.uint8)
+    # reference GF matmul
+    want = np.zeros((4, 16), dtype=np.uint8)
+    for j in range(4):
+        want ^= gf256.gf_mul(A[:, j : j + 1], x[j : j + 1, :])
+    # bit-domain
+    Ab = gf256.bit_expand_matrix(A).astype(np.int32)
+    xb = np.stack([(x >> t) & 1 for t in range(8)], axis=1).reshape(32, 16).astype(np.int32)
+    yb = (Ab @ xb) % 2
+    got = np.zeros((4, 16), dtype=np.uint8)
+    for t in range(8):
+        got |= (yb.reshape(4, 8, 16)[:, t, :] << t).astype(np.uint8)
+    assert np.array_equal(got, want)
+
+
+@pytest.mark.parametrize("k", [1, 2, 4, 8, 16])
+def test_extend_square_matches_reference(k):
+    rng = np.random.default_rng(k)
+    square = rng.integers(0, 256, (k, k, 64), dtype=np.uint8)
+    want = rs.extend_square_ref(square)
+    got = np.asarray(rs.extend_square(square))
+    assert got.dtype == np.uint8
+    assert np.array_equal(got, want), f"device/reference mismatch at k={k}"
+
+
+def test_extend_commutativity_q3():
+    # Q3 via columns-of-Q1 must equal Q3 via rows-of-Q2.
+    rng = np.random.default_rng(9)
+    k = 8
+    square = rng.integers(0, 256, (k, k, 32), dtype=np.uint8)
+    eds = np.asarray(rs.extend_square(square))
+    q2 = eds[k:, :k]
+    q3 = eds[k:, k:]
+    # row-extend Q2 and compare with Q3
+    q3_alt = np.zeros_like(q3)
+    for r in range(k):
+        q3_alt[r] = gf256.encode_shares_ref(q2[r])
+    assert np.array_equal(q3, q3_alt)
+
+
+def test_extend_batched():
+    rng = np.random.default_rng(2)
+    squares = rng.integers(0, 256, (3, 4, 4, 32), dtype=np.uint8)
+    got = np.asarray(rs.extend_squares_batched(squares))
+    for i in range(3):
+        assert np.array_equal(got[i], rs.extend_square_ref(squares[i]))
+
+
+def test_systematic_property():
+    # Q0 of the EDS is the original square, untouched.
+    rng = np.random.default_rng(3)
+    square = rng.integers(0, 256, (8, 8, 16), dtype=np.uint8)
+    eds = np.asarray(rs.extend_square(square))
+    assert np.array_equal(eds[:8, :8], square)
+
+
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_repair_withheld_rows_cols(k):
+    """DAS case: withhold 25% (half the rows and half the cols of the EDS)."""
+    rng = np.random.default_rng(k * 7)
+    square = rng.integers(0, 256, (k, k, 32), dtype=np.uint8)
+    eds = np.asarray(rs.extend_square(square))
+    avail = np.ones((2 * k, 2 * k), dtype=bool)
+    withheld_rows = rng.choice(2 * k, k, replace=False)
+    withheld_cols = rng.choice(2 * k, k, replace=False)
+    avail[withheld_rows, :] = False
+    avail[:, withheld_cols] = False
+    # exactly k rows and k cols remain -> every missing axis still has k cells
+    corrupted = eds.copy()
+    corrupted[~avail] = 0xAA  # garbage must not leak
+    repaired = rs.repair_square(corrupted, avail)
+    assert np.array_equal(repaired, eds)
+
+
+def test_repair_random_cells():
+    rng = np.random.default_rng(11)
+    k = 4
+    square = rng.integers(0, 256, (k, k, 16), dtype=np.uint8)
+    eds = np.asarray(rs.extend_square(square))
+    avail = rng.random((2 * k, 2 * k)) < 0.7
+    # ensure solvable start: keep at least k cells per row
+    for r in range(2 * k):
+        if avail[r].sum() < k:
+            avail[r, rng.choice(2 * k, k, replace=False)] = True
+    repaired = rs.repair_square(eds.copy(), avail)
+    assert np.array_equal(repaired, eds)
+
+
+def test_repair_insufficient_raises():
+    k = 2
+    square = np.zeros((k, k, 8), dtype=np.uint8)
+    eds = np.asarray(rs.extend_square(square))
+    avail = np.zeros((2 * k, 2 * k), dtype=bool)
+    avail[0, 0] = True
+    with pytest.raises(ValueError, match="stalled"):
+        rs.repair_square(eds, avail)

@@ -44,7 +44,7 @@ def test_subtree_width_spec_example():
 
 
 def test_empty_square():
-    square, block_txs = sq.build([])
+    square, block_txs, wrappers = sq.build([])
     assert square.size == 1
     assert block_txs == []
     assert square.is_empty()
@@ -53,7 +53,7 @@ def test_empty_square():
 
 def test_tx_only_square():
     txs = [b"tx-%d" % i for i in range(10)]
-    square, block_txs = sq.build(txs)
+    square, block_txs, wrappers = sq.build(txs)
     assert block_txs == txs
     got_txs, got_pfbs, got_blobs = sq.extract_txs_and_blobs(square)
     assert got_txs == txs and got_pfbs == [] and got_blobs == []
@@ -61,11 +61,12 @@ def test_tx_only_square():
 
 def test_single_blob_square_layout():
     btx = _blob_tx(b"roll", 100)
-    square, block_txs = sq.build([btx.marshal()])
-    # block txs carry the PFB wrapped with its share index
-    assert len(block_txs) == 1
-    w = unmarshal_index_wrapper(block_txs[0])
-    assert w is not None and w.tx == b"pfb"
+    square, block_txs, wrappers = sq.build([btx.marshal()])
+    # block txs are the original envelopes; wrappers carry share indexes
+    assert block_txs == [btx.marshal()]
+    assert len(wrappers) == 1
+    w = wrappers[0]
+    assert w.tx == b"pfb"
     # layout: [pfb compact][blob][tail padding], square size 2
     assert square.size == 2
     assert square.shares[0].namespace.raw == PAY_FOR_BLOB_NAMESPACE.raw
@@ -79,21 +80,18 @@ def test_blobs_sorted_by_namespace_with_padding():
     # Two blobs in reverse namespace order; square must re-sort them.
     btx_b = _blob_tx(b"bbbb", 600, tx=b"pfb-b")  # 2 shares
     btx_a = _blob_tx(b"aaaa", 100, tx=b"pfb-a")  # 1 share
-    square, block_txs = sq.build([btx_b.marshal(), btx_a.marshal()])
+    square, block_txs, wrappers = sq.build([btx_b.marshal(), btx_a.marshal()])
     _, _, blobs = sq.extract_txs_and_blobs(square)
     assert [b[0] for b in blobs] == [ns.Namespace.v0(b"aaaa"), ns.Namespace.v0(b"bbbb")]
     # wrappers keep pfb (priority) order
-    w0 = unmarshal_index_wrapper(block_txs[0])
-    w1 = unmarshal_index_wrapper(block_txs[1])
-    assert w0.tx == b"pfb-b" and w1.tx == b"pfb-a"
+    assert wrappers[0].tx == b"pfb-b" and wrappers[1].tx == b"pfb-a"
 
 
 def test_blob_alignment_subtree_width():
     # A blob of 65 shares has subtree width 2: it must start on an even index.
     big = _blob_tx(b"big1", 478 + 64 * 482, tx=b"pfb-big")  # 65 shares
-    square, block_txs = sq.build([big.marshal()])
-    w = unmarshal_index_wrapper(block_txs[0])
-    start = w.share_indexes[0]
+    square, block_txs, wrappers = sq.build([big.marshal()])
+    start = wrappers[0].share_indexes[0]
     assert start % 2 == 0
     # share 1 (gap between compact shares and blob) is reserved padding
     assert square.shares[1].namespace.raw == PRIMARY_RESERVED_PADDING_NAMESPACE.raw
@@ -103,11 +101,9 @@ def test_namespace_padding_between_blobs():
     # First blob 3 shares (ns A), second blob 65 shares (ns B, width 2).
     a = _blob_tx(b"nsa", 478 + 2 * 482, tx=b"pfb-a")
     b = _blob_tx(b"nsb", 478 + 64 * 482, tx=b"pfb-b")
-    square, block_txs = sq.build([a.marshal(), b.marshal()])
-    wa = unmarshal_index_wrapper(block_txs[0])
-    wb = unmarshal_index_wrapper(block_txs[1])
-    end_a = wa.share_indexes[0] + 3
-    start_b = wb.share_indexes[0]
+    square, block_txs, wrappers = sq.build([a.marshal(), b.marshal()])
+    end_a = wrappers[0].share_indexes[0] + 3
+    start_b = wrappers[1].share_indexes[0]
     assert start_b % 2 == 0
     for i in range(end_a, start_b):
         # gap padding carries the previous blob's namespace
@@ -119,7 +115,7 @@ def test_namespace_padding_between_blobs():
 def test_build_drops_overflow_construct_rejects():
     # blobs of 478 bytes = 1 share each; max square 2 -> 4 shares total.
     txs = [_blob_tx(bytes([i]) * 4, 478, tx=b"pfb%d" % i).marshal() for i in range(8)]
-    square, block_txs = sq.build(txs, max_square_size=2)
+    square, block_txs, wrappers = sq.build(txs, max_square_size=2)
     assert square.size == 2
     assert 0 < len(block_txs) < 8  # some dropped
     with pytest.raises(ValueError):
@@ -134,18 +130,19 @@ def test_build_construct_determinism():
         raws.append(_blob_tx(bytes([65 + i]) * 3, n, tx=b"pfb%d" % i).marshal())
     raws.insert(0, b"normal-tx-1")
     raws.insert(5, b"normal-tx-2")
-    square1, block_txs = sq.build(raws)
+    square1, block_txs, wrappers1 = sq.build(raws)
     # A validator reconstructing from the identical tx list must get the
     # identical square (ProcessProposal parity, app/process_proposal.go:121).
-    square2, block_txs2 = sq.construct(raws, max_square_size=square1.size)
+    square2, block_txs2, wrappers2 = sq.construct(raws, max_square_size=square1.size)
     assert square1.size == square2.size
     assert [s.raw for s in square1.shares] == [s.raw for s in square2.shares]
     assert block_txs == block_txs2
+    assert wrappers1 == wrappers2
 
 
 def test_square_to_array():
     btx = _blob_tx(b"arr2", 1000)
-    square, _ = sq.build([btx.marshal()])
+    square, _, _ = sq.build([btx.marshal()])
     arr = square.to_array()
     assert arr.shape == (square.size**2, 512)
 
@@ -156,7 +153,7 @@ def test_invalid_blob_tx_dropped_by_build_rejected_by_construct():
     bad_ns = BlobTx(tx=b"bad", blobs=(Blob(TRANSACTION_NAMESPACE, b"d"),)).marshal()
     bad_ver = BlobTx(tx=b"bad", blobs=(Blob(ns.Namespace.v0(b"ok"), b"d", share_version=1),)).marshal()
     good = _blob_tx(b"good", 100).marshal()
-    square, block_txs = sq.build([bad_ns, bad_ver, good])
+    square, block_txs, _ = sq.build([bad_ns, bad_ver, good])
     assert len(block_txs) == 1  # both invalid txs dropped
     for bad in (bad_ns, bad_ver):
         with pytest.raises(ValueError):
@@ -183,19 +180,34 @@ def test_parse_compact_shares_strict():
         shmod.parse_compact_shares([shares[0], shmod.Share(bytes(tampered))])
 
 
-def test_builder_fit_bounds_match_exact_layout():
-    # Randomized: incremental bounds must agree with a fresh exact rebuild.
-    rng = np.random.default_rng(3)
-    raws = []
-    for i in range(40):
-        n = int(rng.integers(1, 4000))
+def test_build_output_feeds_construct():
+    """The proposer's returned block txs ARE what validators reconstruct from
+    (PrepareProposal -> ProcessProposal round trip), including after drops."""
+    rng = np.random.default_rng(5)
+    raws = [b"normal-tx"]
+    for i in range(30):
+        n = int(rng.integers(1, 3000))
         raws.append(_blob_tx(bytes([65 + i % 26]) * 2, n, tx=b"p%d" % i).marshal())
-    square, block_txs = sq.build(raws, max_square_size=8)
-    # rebuild from kept txs only; must fit exactly and reproduce the square
-    kept = []
-    import celestia_tpu.da.blob as blobmod
-    for t in block_txs:
-        w = blobmod.unmarshal_index_wrapper(t)
-        assert w is not None
-    square2, _ = sq.construct(raws[: 0], max_square_size=8)  # empty is fine
-    assert square.size <= 8
+    square, block_txs, wrappers = sq.build(raws, max_square_size=4)
+    assert len(block_txs) < len(raws)  # some dropped at size 4
+    square2, block_txs2, wrappers2 = sq.construct(block_txs, max_square_size=square.size)
+    assert [s.raw for s in square.shares] == [s.raw for s in square2.shares]
+    assert block_txs2 == block_txs and wrappers2 == wrappers
+
+
+def test_builder_fit_bounds_match_exact_layout():
+    """After every append, the O(1) fits() verdict must agree with an exact
+    fresh layout computation."""
+    rng = np.random.default_rng(3)
+    b = sq.Builder(max_square_size=8)
+    for i in range(60):
+        n = int(rng.integers(1, 4000))
+        btx = _blob_tx(bytes([65 + i % 26]) * 2, n, tx=b"p%d" % i)
+        try:
+            b.append_blob_tx(btx)
+        except ValueError:
+            pass
+        total, _, _, _ = b._layout()
+        exact_fits = sq.min_square_size(max(total, 1)) <= b.max_square_size
+        assert b.fits() == exact_fits
+        assert exact_fits  # rollback keeps the builder within bounds

@@ -1,0 +1,234 @@
+"""Extended data square + DataAvailabilityHeader: the block-extension hot path.
+
+Behavioral parity with /root/reference/pkg/da/data_availability_header.go
+(ExtendShares :65-75, NewDataAvailabilityHeader :44-63, Hash :92-108,
+ValidateBasic :134-177, MinDataAvailabilityHeader :179) and
+app/extend_block.go:14-32 — redesigned as one fused, jit-compiled device
+program: RS-extend (ops/rs.py bit-matmuls) -> all 4k NMT axis roots
+(ops/nmt.py level-synchronous reduction) -> RFC-6962 data root, in a single
+XLA executable per square size.  This runs twice per block per validator
+(PrepareProposal / ProcessProposal) and is the BASELINE.json north star.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from celestia_tpu.appconsts import (
+    DEFAULT_SQUARE_SIZE_UPPER_BOUND,
+    SHARE_SIZE,
+    is_power_of_two,
+)
+from celestia_tpu.da.square import Square
+from celestia_tpu.ops import nmt as nmt_ops
+from celestia_tpu.ops import rs
+from celestia_tpu.ops.gf256 import encode_matrix_bits
+
+NMT_ROOT_SIZE = nmt_ops.NMT_DIGEST_SIZE  # 90
+DATA_ROOT_SIZE = 32
+
+
+class ExtendedDataSquare:
+    """A 2k x 2k erasure-extended share square (rsmt2d.ExtendedDataSquare parity).
+
+    Holds the share tensor uint8[2k, 2k, 512]; Q0 (top-left k x k) is the
+    original data square.
+    """
+
+    def __init__(self, shares: np.ndarray):
+        shares = np.asarray(shares, dtype=np.uint8)
+        n = shares.shape[0]
+        if shares.shape != (n, n, SHARE_SIZE) or n % 2 or not is_power_of_two(n // 2):
+            raise ValueError(f"invalid EDS shape {shares.shape}")
+        self.shares = shares
+
+    @property
+    def width(self) -> int:
+        return self.shares.shape[0]
+
+    @property
+    def square_size(self) -> int:
+        """Original (unextended) square width k."""
+        return self.width // 2
+
+    def row(self, r: int) -> np.ndarray:
+        return self.shares[r]
+
+    def col(self, c: int) -> np.ndarray:
+        return self.shares[:, c]
+
+    def quadrant(self, q: int) -> np.ndarray:
+        k = self.square_size
+        r, c = divmod(q, 2)
+        return self.shares[r * k : (r + 1) * k, c * k : (c + 1) * k]
+
+    def flattened_original(self) -> np.ndarray:
+        """Q0 as uint8[k*k, 512] (row-major original shares)."""
+        k = self.square_size
+        return self.quadrant(0).reshape(k * k, SHARE_SIZE)
+
+
+@lru_cache(maxsize=None)
+def _extend_and_roots_fn(k: int):
+    """Jitted fused pipeline for square size k:
+    square uint8[k,k,512] -> (eds, row_roots[2k,90], col_roots[2k,90], data_root[32])."""
+    G = jnp.asarray(encode_matrix_bits(k))
+
+    def run(square: jnp.ndarray):
+        eds = rs._extend(square, G)
+        roots = nmt_ops.eds_nmt_roots(eds)  # (2, 2k, 90)
+        all_roots = roots.reshape(4 * k, NMT_ROOT_SIZE)
+        data_root = nmt_ops.rfc6962_root_pow2(all_roots)
+        return eds, roots[0], roots[1], data_root
+
+    return jax.jit(run)
+
+
+@dataclass(frozen=True)
+class DataAvailabilityHeader:
+    """Row/column NMT roots + memoized hash (= the block's data root)."""
+
+    row_roots: Tuple[bytes, ...]
+    col_roots: Tuple[bytes, ...]
+    _hash: bytes
+
+    @property
+    def hash(self) -> bytes:
+        return self._hash
+
+    @property
+    def square_size(self) -> int:
+        return len(self.row_roots) // 2
+
+    def validate_basic(self) -> None:
+        """dah ValidateBasic parity: extended square bounds + root shapes +
+        hash consistency (data_availability_header.go:134-177)."""
+        n = len(self.row_roots)
+        if n == 0 or n != len(self.col_roots):
+            raise ValueError("row/col root counts must match and be non-empty")
+        k = n // 2
+        if n % 2 or not is_power_of_two(k):
+            raise ValueError(f"extended square width {n} must be 2 * power-of-two")
+        if k > DEFAULT_SQUARE_SIZE_UPPER_BOUND:
+            raise ValueError(
+                f"square size {k} exceeds upper bound {DEFAULT_SQUARE_SIZE_UPPER_BOUND}"
+            )
+        for r in (*self.row_roots, *self.col_roots):
+            if len(r) != NMT_ROOT_SIZE:
+                raise ValueError(f"NMT root must be {NMT_ROOT_SIZE} bytes")
+        if self.compute_hash(self.row_roots, self.col_roots) != self._hash:
+            raise ValueError("DAH hash does not match its roots")
+
+    @staticmethod
+    def compute_hash(row_roots, col_roots) -> bytes:
+        return nmt_ops.rfc6962_root_np(list(row_roots) + list(col_roots)).tobytes()
+
+    def to_bytes(self) -> bytes:
+        """Deterministic wire form: counts + concatenated roots."""
+        out = bytearray()
+        out += len(self.row_roots).to_bytes(4, "big")
+        for r in self.row_roots:
+            out += r
+        out += len(self.col_roots).to_bytes(4, "big")
+        for c in self.col_roots:
+            out += c
+        return bytes(out)
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "DataAvailabilityHeader":
+        n_rows = int.from_bytes(raw[:4], "big")
+        pos = 4
+        rows = []
+        for _ in range(n_rows):
+            rows.append(raw[pos : pos + NMT_ROOT_SIZE])
+            pos += NMT_ROOT_SIZE
+        n_cols = int.from_bytes(raw[pos : pos + 4], "big")
+        pos += 4
+        cols = []
+        for _ in range(n_cols):
+            cols.append(raw[pos : pos + NMT_ROOT_SIZE])
+            pos += NMT_ROOT_SIZE
+        if pos != len(raw):
+            raise ValueError("trailing bytes in DAH encoding")
+        dah = cls(tuple(rows), tuple(cols), cls.compute_hash(rows, cols))
+        dah.validate_basic()
+        return dah
+
+
+def extend_shares(shares: np.ndarray) -> ExtendedDataSquare:
+    """da.ExtendShares parity: uint8[n, 512] (n a perfect power-of-4 count)
+    -> ExtendedDataSquare."""
+    shares = np.asarray(shares, dtype=np.uint8)
+    n = shares.shape[0]
+    k = int(round(n**0.5))
+    if k * k != n or not is_power_of_two(k):
+        raise ValueError(f"share count {n} must be a square of a power of two")
+    square = shares.reshape(k, k, SHARE_SIZE)
+    eds = np.asarray(rs.extend_square(square))
+    return ExtendedDataSquare(eds)
+
+
+def extend_and_header(
+    square: np.ndarray,
+) -> Tuple[ExtendedDataSquare, "DataAvailabilityHeader"]:
+    """The fused hot path: original square uint8[k,k,512] -> (EDS, DAH).
+
+    One device program computes extension, 4k NMT roots and the data root
+    (the reference does this as ExtendShares + NewDataAvailabilityHeader,
+    app/prepare_proposal.go:65-77).
+    """
+    square = np.asarray(square, dtype=np.uint8)
+    k = square.shape[0]
+    eds_d, row_roots, col_roots, data_root = _extend_and_roots_fn(k)(
+        jnp.asarray(square)
+    )
+    eds = ExtendedDataSquare(np.asarray(eds_d))
+    rr = np.asarray(row_roots)
+    cc = np.asarray(col_roots)
+    dah = DataAvailabilityHeader(
+        tuple(rr[i].tobytes() for i in range(rr.shape[0])),
+        tuple(cc[i].tobytes() for i in range(cc.shape[0])),
+        np.asarray(data_root).tobytes(),
+    )
+    return eds, dah
+
+
+def new_data_availability_header(eds: ExtendedDataSquare) -> DataAvailabilityHeader:
+    """da.NewDataAvailabilityHeader parity: roots + hash from an existing EDS."""
+    roots = np.asarray(
+        jax.jit(nmt_ops.eds_nmt_roots)(jnp.asarray(eds.shares))
+    )
+    rows = tuple(roots[0, i].tobytes() for i in range(roots.shape[1]))
+    cols = tuple(roots[1, i].tobytes() for i in range(roots.shape[1]))
+    return DataAvailabilityHeader(
+        rows, cols, DataAvailabilityHeader.compute_hash(rows, cols)
+    )
+
+
+def extend_block(square: Square) -> Tuple[ExtendedDataSquare, DataAvailabilityHeader]:
+    """app.ExtendBlock parity (extend_block.go:14-26): square -> (EDS, DAH)."""
+    k = square.size
+    arr = square.to_array().reshape(k, k, SHARE_SIZE)
+    return extend_and_header(arr)
+
+
+_min_dah_cache: Optional[DataAvailabilityHeader] = None
+
+
+def min_data_availability_header() -> DataAvailabilityHeader:
+    """DAH of the minimal (empty) square: one tail-padding share
+    (data_availability_header.go:179)."""
+    global _min_dah_cache
+    if _min_dah_cache is None:
+        from celestia_tpu.da.square import build
+
+        square, _, _ = build([])
+        _, dah = extend_block(square)
+        _min_dah_cache = dah
+    return _min_dah_cache

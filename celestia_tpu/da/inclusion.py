@@ -1,0 +1,88 @@
+"""Blob share commitments: merkle-mountain-range subtree roots (ADR-013).
+
+Parity with go-square/inclusion.CreateCommitment as used by
+/root/reference/x/blob/types/payforblob.go:49-56 (commitment creation) and
+x/blob/types/blob_tx.go:98-107 (re-verification in ProcessProposal), and
+with pkg/inclusion's commitment-from-EDS path conceptually: a blob's
+commitment is the RFC-6962 merkle root over the NMT roots of its aligned
+subtrees, whose widths form a merkle mountain range bounded by
+SubtreeWidth(blob) — making the commitment independent of the square size
+and equal to the subtree roots that appear in the row NMTs.
+
+Subtree NMT roots are computed on device, batched by mountain width.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from celestia_tpu.appconsts import (
+    DEFAULT_SUBTREE_ROOT_THRESHOLD,
+    NAMESPACE_SIZE,
+    round_down_power_of_two,
+)
+from celestia_tpu.da.blob import Blob
+from celestia_tpu.da.shares import shares_to_array, split_blob_into_shares
+from celestia_tpu.da.square import subtree_width
+from celestia_tpu.ops import nmt as nmt_ops
+
+
+def merkle_mountain_range_sizes(total: int, max_tree_size: int) -> List[int]:
+    """Decompose ``total`` leaves into descending power-of-two mountains
+    capped at ``max_tree_size``."""
+    sizes: List[int] = []
+    while total:
+        if total >= max_tree_size:
+            sizes.append(max_tree_size)
+            total -= max_tree_size
+        else:
+            p = round_down_power_of_two(total)
+            sizes.append(p)
+            total -= p
+    return sizes
+
+
+@jax.jit
+def _subtree_roots(leaves: jnp.ndarray) -> jnp.ndarray:
+    """uint8[n_trees, width, 541] -> uint8[n_trees, 90]."""
+    return nmt_ops.nmt_roots(leaves)
+
+
+def create_commitment(
+    blob: Blob, subtree_root_threshold: int = DEFAULT_SUBTREE_ROOT_THRESHOLD
+) -> bytes:
+    """32-byte share commitment of a blob."""
+    shares = split_blob_into_shares(blob.namespace, blob.data, blob.share_version)
+    arr = shares_to_array(shares)  # (n, 512)
+    n = arr.shape[0]
+    width = subtree_width(n, subtree_root_threshold)
+    sizes = merkle_mountain_range_sizes(n, width)
+    # NMT leaves: namespace-prefixed shares (Q0 rule — own namespace).
+    ns = np.broadcast_to(
+        np.frombuffer(blob.namespace.raw, dtype=np.uint8), (n, NAMESPACE_SIZE)
+    )
+    leaves = np.concatenate([ns, arr], axis=1)  # (n, 541)
+    # batch subtree roots by mountain size
+    roots: List[bytes] = [b""] * len(sizes)
+    offset = 0
+    offsets = []
+    for s in sizes:
+        offsets.append(offset)
+        offset += s
+    by_size = {}
+    for i, s in enumerate(sizes):
+        by_size.setdefault(s, []).append(i)
+    for s, idxs in by_size.items():
+        batch = np.stack([leaves[offsets[i] : offsets[i] + s] for i in idxs])
+        out = np.asarray(_subtree_roots(jnp.asarray(batch)))
+        for j, i in enumerate(idxs):
+            roots[i] = out[j].tobytes()
+    return nmt_ops.rfc6962_root_np(roots).tobytes()
+
+
+def create_commitments(blobs: List[Blob]) -> List[bytes]:
+    return [create_commitment(b) for b in blobs]

@@ -96,7 +96,12 @@ def _extend_batched_fn(k: int):
 def extend_squares_batched(squares) -> jnp.ndarray:
     """Extend a batch uint8[n, k, k, 512] -> uint8[n, 2k, 2k, 512]."""
     squares = jnp.asarray(squares, dtype=jnp.uint8)
-    return _extend_batched_fn(squares.shape[1])(squares)
+    k = squares.shape[1]
+    if squares.ndim != 4 or squares.shape[2] != k or not is_power_of_two(k):
+        raise ValueError(
+            f"batch must be (n, k, k, B) with k a power of two, got {squares.shape}"
+        )
+    return _extend_batched_fn(k)(squares)
 
 
 # ---------------------------------------------------------------------------
@@ -139,6 +144,12 @@ def decode_axes(rows: np.ndarray, known_points: np.ndarray) -> np.ndarray:
     return np.asarray(out)[:n]
 
 
+class ByzantineError(ValueError):
+    """The available shares are not a consistent Reed-Solomon codeword
+    (rsmt2d ErrByzantine parity): a malicious proposer published shares that
+    disagree with the polynomial through the rest of their row/column."""
+
+
 def repair_square(eds: np.ndarray, available: np.ndarray) -> np.ndarray:
     """Reconstruct a full EDS from a partial one (rsmt2d.Repair parity).
 
@@ -147,8 +158,13 @@ def repair_square(eds: np.ndarray, available: np.ndarray) -> np.ndarray:
     Iteratively solves every row/column with >= k available cells, batching
     axes that share an availability mask into one device matmul, until the
     square is complete.  Raises ValueError if reconstruction stalls
-    (insufficient data — fewer than k cells in every incomplete axis).
+    (insufficient data — fewer than k cells in every incomplete axis), and
+    :class:`ByzantineError` if the provided shares are not a consistent
+    codeword: after completion the square is re-extended from Q0 and every
+    originally-available cell must match what was provided (this also
+    catches inconsistent fully-available axes that need no solving).
     """
+    original_eds = np.array(eds, dtype=np.uint8, copy=True)
     eds = np.array(eds, dtype=np.uint8, copy=True)
     avail = np.array(available, dtype=bool, copy=True)
     n2 = eds.shape[0]
@@ -187,6 +203,25 @@ def repair_square(eds: np.ndarray, available: np.ndarray) -> np.ndarray:
             raise ValueError(
                 "repair stalled: insufficient available cells to reconstruct"
             )
+
+    # Byzantine check: the completed square must be the unique codeword
+    # extending its Q0, and every share the caller actually provided must
+    # agree with it.  (rsmt2d returns ErrByzantine from Repair here.)
+    orig_avail = np.asarray(available, dtype=bool)
+    provided = np.array(original_eds, dtype=np.uint8, copy=False)
+    recomputed = np.asarray(extend_square(eds[:k, :k]))
+    if not np.array_equal(eds, recomputed):
+        bad = np.nonzero((eds != recomputed).any(axis=2))
+        raise ByzantineError(
+            f"inconsistent erasure coding at cells {list(zip(*bad))[:8]}"
+        )
+    mismatch = orig_avail & (provided != recomputed).any(axis=2)
+    if mismatch.any():
+        bad = np.nonzero(mismatch)
+        raise ByzantineError(
+            f"provided shares disagree with the reconstructed codeword at "
+            f"cells {list(zip(*bad))[:8]}"
+        )
     return eds
 
 

@@ -1,0 +1,221 @@
+"""Multi-chip sharded block extension: shard_map over a jax.sharding.Mesh.
+
+The TPU-native replacement for the reference's intra-block parallelism
+(rsmt2d's goroutine row/col fan-out, SURVEY.md §2.3): rows of the original
+square are sharded across the ``row`` mesh axis (ICI), whole squares are
+batched across the ``data`` axis (multi-block validator catch-up,
+BASELINE.json config #5).
+
+Communication pattern (all XLA collectives over ICI):
+
+* Q1 (row parity): fully local — each device encodes its own row shard.
+* Q2/Q3 (column parity): the GF(2) contraction runs over the sharded row
+  axis, so each device computes a partial bit-matmul against its slice of
+  the encode matrix, reduced with ``psum_scatter`` so every device ends up
+  holding only its shard of the parity rows (a reduce-scatter, not an
+  all-reduce — 1/R the traffic).
+* Row-tree NMT roots: local.  Column-tree NMT roots: each device reduces its
+  local rows of every column to one subtree node, then an ``all_gather`` of
+  those (tiny: R x 2k x 90 bytes) finishes the top log2(R) levels
+  replicated on every device.
+* Data root: row/col roots are all-gathered (2 x 2k x 90 bytes) and the
+  RFC-6962 reduction is computed replicated — every device holds the same
+  data root, the sharded analogue of the DAH hash at
+  /root/reference/pkg/da/data_availability_header.go:92-108.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from celestia_tpu.appconsts import NAMESPACE_SIZE, SHARE_SIZE
+from celestia_tpu.ops import nmt as nmt_ops
+from celestia_tpu.ops import rs
+from celestia_tpu.ops.gf256 import encode_matrix_bits
+from celestia_tpu.ops.nmt import NMT_DIGEST_SIZE, _PARITY_NS
+
+
+def make_mesh(devices=None, data: int = 1, row: int = None) -> Mesh:
+    """Build a ("data", "row") mesh over the given (or all) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if row is None:
+        row = n // data
+    if data * row != n:
+        raise ValueError(f"data*row = {data}*{row} != device count {n}")
+    arr = np.asarray(devices).reshape(data, row)
+    return Mesh(arr, ("data", "row"))
+
+
+def _extend_rows_local(q_top: jnp.ndarray, G: jnp.ndarray) -> jnp.ndarray:
+    """Row parity for the local row shard: (r, k, B) -> (r, k, B)."""
+    return rs.pack_bits(rs.matmul_gf2(G, rs.unpack_bits(q_top)))
+
+
+def _sharded_extend_and_roots(square_shard: jnp.ndarray, G: jnp.ndarray, k: int,
+                              n_row_shards: int):
+    """shard_map body: square_shard (k/R, k, 512) local rows -> per-device
+    outputs (local EDS rows slice, replicated roots + data root)."""
+    R = n_row_shards
+    rows_local = k // R
+    shard_id = jax.lax.axis_index("row")
+
+    # --- Q1: local row extension ------------------------------------------
+    q1 = _extend_rows_local(square_shard, G)  # (k/R, k, B)
+    top = jnp.concatenate([square_shard, q1], axis=1)  # (k/R, 2k, B)
+
+    # --- Q2/Q3: column parity via sharded contraction ---------------------
+    # Columns hold k values spread across the row shards; the encode matrix
+    # contracts over all 8k bit-rows.  Device d multiplies its (8k/R)-slice
+    # of G's columns with its local bits, then psum_scatter sums partials
+    # and scatters the 8k output bit-rows back across the row axis.
+    bits_local = rs.unpack_bits(top.transpose(1, 0, 2))  # (2k, 8*k/R, B)
+    g_cols = jax.lax.dynamic_slice_in_dim(
+        G, shard_id * (8 * rows_local), 8 * rows_local, axis=1
+    )  # (8k, 8k/R)
+    partial = jnp.matmul(g_cols, bits_local, preferred_element_type=jnp.int32)
+    # (2k, 8k, B) partial sums; reduce-scatter over the output bit-row axis.
+    partial = partial.transpose(1, 0, 2)  # (8k, 2k, B)
+    summed = jax.lax.psum_scatter(partial, "row", scatter_dimension=0, tiled=True)
+    bot_bits = (summed & 1).astype(jnp.int8)  # (8k/R, 2k, B)
+    bot = rs.pack_bits(
+        bot_bits.reshape(rows_local, 8, 2 * k, SHARE_SIZE)
+        .transpose(2, 0, 1, 3)
+        .reshape(2 * k, 8 * rows_local, SHARE_SIZE)
+    ).transpose(1, 0, 2)  # (k/R, 2k, B) local parity rows
+    # Note: psum_scatter gives contiguous slices in shard order, so device d
+    # holds parity rows [d*k/R, (d+1)*k/R) — same contiguous layout as Q0.
+
+    # --- NMT leaves with namespace prefixes --------------------------------
+    # Global row indexes of this device's rows: top half r0+i, bottom half
+    # k + r0 + i; Q0 membership needs global (row, col) coordinates.
+    r0 = shard_id * rows_local
+    col_idx = jnp.arange(2 * k)
+    parity_ns = jnp.asarray(_PARITY_NS)
+
+    def prefixed(rows, global_row_offset):
+        own = rows[..., :NAMESPACE_SIZE]
+        grow = global_row_offset + jnp.arange(rows.shape[0])
+        in_q0 = (grow[:, None] < k) & (col_idx[None, :] < k)
+        pref = jnp.where(in_q0[..., None], own, jnp.broadcast_to(parity_ns, own.shape))
+        return jnp.concatenate([pref, rows], axis=-1)
+
+    top_leaves = prefixed(top, r0)  # (k/R, 2k, 541)
+    bot_leaves = prefixed(bot, k + r0)
+
+    # --- row-tree roots: fully local ---------------------------------------
+    top_row_roots = nmt_ops.nmt_roots(top_leaves)  # (k/R, 90)
+    bot_row_roots = nmt_ops.nmt_roots(bot_leaves)
+    row_roots = jnp.concatenate(
+        [
+            jax.lax.all_gather(top_row_roots, "row", axis=0, tiled=True),
+            jax.lax.all_gather(bot_row_roots, "row", axis=0, tiled=True),
+        ],
+        axis=0,
+    )  # (2k, 90) replicated
+
+    # --- column-tree roots: local subtree reduce + gathered finish ---------
+    # Column-tree leaves are ordered by global row: [top rows..., bottom
+    # rows...].  Device d holds two contiguous leaf blocks per column (its Q0
+    # /Q1 rows and its Q2/Q3 rows); reduce each block to one subtree node,
+    # all_gather the 2R nodes per column (in global order), finish locally.
+    col_leaves_top = top_leaves.transpose(1, 0, 2)  # (2k cols, k/R, 541)
+    col_leaves_bot = bot_leaves.transpose(1, 0, 2)
+
+    def reduce_block(leaves):
+        nodes = nmt_ops.leaf_digests(leaves)
+        while nodes.shape[-2] > 1:
+            nodes = nmt_ops.combine_level(nodes)
+        return nodes[..., 0, :]  # (2k, 90)
+
+    sub_top = reduce_block(col_leaves_top)
+    sub_bot = reduce_block(col_leaves_bot)
+    # gather per-device subtree nodes in global row order
+    g_top = jax.lax.all_gather(sub_top, "row", axis=0)  # (R, 2k, 90)
+    g_bot = jax.lax.all_gather(sub_bot, "row", axis=0)
+    nodes = jnp.concatenate([g_top, g_bot], axis=0)  # (2R, 2k, 90)
+    nodes = nodes.transpose(1, 0, 2)  # (2k cols, 2R, 90)
+    while nodes.shape[-2] > 1:
+        nodes = nmt_ops.combine_level(nodes)
+    col_roots = nodes[..., 0, :]  # (2k, 90) replicated
+
+    # --- data root ----------------------------------------------------------
+    all_roots = jnp.concatenate([row_roots, col_roots], axis=0)  # (4k, 90)
+    data_root = nmt_ops.rfc6962_root_pow2(all_roots)  # (32,) replicated
+
+    eds_local = jnp.concatenate([top[:, None], bot[:, None]], axis=1)
+    # (k/R, 2, 2k, B): [:, 0] = top-half rows, [:, 1] = bottom-half rows
+    return eds_local, row_roots, col_roots, data_root
+
+
+@lru_cache(maxsize=None)
+def _sharded_fn(mesh: Mesh, k: int, batched: bool):
+    R = mesh.shape["row"]
+    if k % R:
+        raise ValueError(f"square size {k} not divisible by row shards {R}")
+    G = jnp.asarray(encode_matrix_bits(k))
+    body = partial(_sharded_extend_and_roots, G=G, k=k, n_row_shards=R)
+
+    if not batched:
+        fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=P("row", None, None),
+            out_specs=(P("row", None, None, None), P(), P(), P()),
+            check_rep=False,
+        )
+        return jax.jit(fn)
+
+    vbody = jax.vmap(body)
+    fn = shard_map(
+        vbody,
+        mesh=mesh,
+        in_specs=P("data", "row", None, None),
+        out_specs=(
+            P("data", "row", None, None, None),
+            P("data"),
+            P("data"),
+            P("data"),
+        ),
+        check_rep=False,
+    )
+    return jax.jit(fn)
+
+
+def _reassemble_eds(eds_local: np.ndarray, k: int) -> np.ndarray:
+    """(k, 2, 2k, B) row-shard layout -> (2k, 2k, B)."""
+    top = eds_local[:, 0]  # (k, 2k, B)
+    bot = eds_local[:, 1]
+    return np.concatenate([top, bot], axis=0)
+
+
+def extend_and_roots_sharded(square: np.ndarray, mesh: Mesh):
+    """Sharded fused hot path on a mesh: square uint8[k,k,512] ->
+    (eds uint8[2k,2k,512], row_roots, col_roots, data_root)."""
+    square = np.asarray(square, dtype=np.uint8)
+    k = square.shape[0]
+    sharding = NamedSharding(mesh, P("row", None, None))
+    x = jax.device_put(jnp.asarray(square), sharding)
+    eds_local, row_roots, col_roots, data_root = _sharded_fn(mesh, k, False)(x)
+    eds = _reassemble_eds(np.asarray(eds_local), k)
+    return eds, np.asarray(row_roots), np.asarray(col_roots), np.asarray(data_root)
+
+
+def extend_and_roots_sharded_batch(squares: np.ndarray, mesh: Mesh):
+    """Batched sharded path: uint8[n, k, k, 512], n divisible by the data
+    axis -> (eds[n,2k,2k,512], row_roots[n,2k,90], col_roots[n,2k,90],
+    data_roots[n,32])."""
+    squares = np.asarray(squares, dtype=np.uint8)
+    n, k = squares.shape[0], squares.shape[1]
+    sharding = NamedSharding(mesh, P("data", "row", None, None))
+    x = jax.device_put(jnp.asarray(squares), sharding)
+    eds_local, row_roots, col_roots, data_roots = _sharded_fn(mesh, k, True)(x)
+    eds_local = np.asarray(eds_local)
+    eds = np.stack([_reassemble_eds(eds_local[i], k) for i in range(n)])
+    return eds, np.asarray(row_roots), np.asarray(col_roots), np.asarray(data_roots)

@@ -1,0 +1,77 @@
+"""x/bank equivalent: balances, transfers, module accounts, supply.
+
+Parity role: cosmos-sdk bank keeper (fee deduction in the ante chain, mint
+module provisioning, staking bonding — SURVEY.md §2.1).  Single native denom
+``utia`` (appconsts.BondDenom).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict
+
+from celestia_tpu.state.store import KVStore
+
+_BALANCE_PREFIX = b"bal/"
+_SUPPLY_KEY = b"supply"
+
+
+def module_address(name: str) -> bytes:
+    """Deterministic address of a module account (fee collector, mint, bonded pool)."""
+    return hashlib.sha256(b"module/" + name.encode()).digest()[:20]
+
+
+FEE_COLLECTOR = module_address("fee_collector")
+MINT_MODULE = module_address("mint")
+BONDED_POOL = module_address("bonded_tokens_pool")
+NOT_BONDED_POOL = module_address("not_bonded_tokens_pool")
+
+
+class BankKeeper:
+    def __init__(self, store: KVStore):
+        self.store = store
+
+    def balance(self, addr: bytes) -> int:
+        raw = self.store.get(_BALANCE_PREFIX + addr)
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def _set_balance(self, addr: bytes, amount: int) -> None:
+        if amount < 0:
+            raise ValueError("negative balance")
+        if amount == 0:
+            self.store.delete(_BALANCE_PREFIX + addr)
+        else:
+            self.store.set(_BALANCE_PREFIX + addr, amount.to_bytes(16, "big"))
+
+    def supply(self) -> int:
+        raw = self.store.get(_SUPPLY_KEY)
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def send(self, from_addr: bytes, to_addr: bytes, amount: int) -> None:
+        if amount < 0:
+            raise ValueError("negative send amount")
+        bal = self.balance(from_addr)
+        if bal < amount:
+            raise ValueError(
+                f"insufficient funds: balance {bal}utia < {amount}utia"
+            )
+        self._set_balance(from_addr, bal - amount)
+        self._set_balance(to_addr, self.balance(to_addr) + amount)
+
+    def mint(self, to_addr: bytes, amount: int) -> None:
+        """Create new supply (x/mint BeginBlocker provisioning)."""
+        self._set_balance(to_addr, self.balance(to_addr) + amount)
+        self.store.set(_SUPPLY_KEY, (self.supply() + amount).to_bytes(16, "big"))
+
+    def burn(self, from_addr: bytes, amount: int) -> None:
+        bal = self.balance(from_addr)
+        if bal < amount:
+            raise ValueError("insufficient funds to burn")
+        self._set_balance(from_addr, bal - amount)
+        self.store.set(_SUPPLY_KEY, (self.supply() - amount).to_bytes(16, "big"))
+
+    def all_balances(self) -> Dict[bytes, int]:
+        return {
+            k[len(_BALANCE_PREFIX):]: int.from_bytes(v, "big")
+            for k, v in self.store.iterate(_BALANCE_PREFIX)
+        }

@@ -1,0 +1,127 @@
+"""Staking keeper (lite): validators, voting power, delegations, unbonding,
+and staking hooks.
+
+Parity role: the cosmos-sdk staking keeper surface the reference actually
+depends on — validator set + powers for x/upgrade's 5/6 quorum tally
+(x/upgrade/keeper.go:137 TallyVotingPower) and for x/blobstream valsets
+(keeper_valset.go GetCurrentValset), plus AfterValidatorBeginUnbonding /
+AfterValidatorCreated hooks that trigger valset attestations
+(x/blobstream/keeper/hooks.go:24-43).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from celestia_tpu.da.shares import _read_varint, _varint
+from celestia_tpu.state.bank import BONDED_POOL, NOT_BONDED_POOL, BankKeeper
+from celestia_tpu.state.store import KVStore
+
+_VAL_PREFIX = b"val/"
+_DEL_PREFIX = b"del/"
+
+POWER_REDUCTION = 1_000_000  # utia per unit of consensus power
+
+
+@dataclass
+class Validator:
+    operator: bytes  # 20-byte address
+    tokens: int  # bonded utia
+    jailed: bool = False
+
+    @property
+    def power(self) -> int:
+        return self.tokens // POWER_REDUCTION
+
+    def marshal(self) -> bytes:
+        out = bytearray()
+        out += _varint(self.tokens)
+        out += _varint(1 if self.jailed else 0)
+        return bytes(out)
+
+    @classmethod
+    def unmarshal(cls, operator: bytes, raw: bytes) -> "Validator":
+        tokens, pos = _read_varint(raw, 0)
+        jailed, pos = _read_varint(raw, pos)
+        return cls(operator, tokens, bool(jailed))
+
+
+class StakingKeeper:
+    def __init__(self, store: KVStore, bank: BankKeeper):
+        self.store = store
+        self.bank = bank
+        # blobstream subscribes to these (x/blobstream/keeper/hooks.go)
+        self.hooks_after_validator_created: List[Callable[[bytes], None]] = []
+        self.hooks_after_unbonding_initiated: List[Callable[[bytes], None]] = []
+
+    # --- validators -------------------------------------------------------
+
+    def validator(self, operator: bytes) -> Optional[Validator]:
+        raw = self.store.get(_VAL_PREFIX + operator)
+        return Validator.unmarshal(operator, raw) if raw is not None else None
+
+    def set_validator(self, v: Validator) -> None:
+        self.store.set(_VAL_PREFIX + v.operator, v.marshal())
+
+    def validators(self) -> List[Validator]:
+        return [
+            Validator.unmarshal(k[len(_VAL_PREFIX):], v)
+            for k, v in self.store.iterate(_VAL_PREFIX)
+        ]
+
+    def bonded_validators(self) -> List[Validator]:
+        return [v for v in self.validators() if not v.jailed and v.power > 0]
+
+    def total_power(self) -> int:
+        return sum(v.power for v in self.bonded_validators())
+
+    def create_validator(self, operator: bytes, self_delegation: int) -> Validator:
+        if self.validator(operator) is not None:
+            raise ValueError("validator already exists")
+        v = Validator(operator, 0)
+        self.set_validator(v)
+        self.delegate(operator, operator, self_delegation)
+        for hook in self.hooks_after_validator_created:
+            hook(operator)
+        return self.validator(operator)
+
+    # --- delegations ------------------------------------------------------
+
+    def delegation(self, delegator: bytes, operator: bytes) -> int:
+        raw = self.store.get(_DEL_PREFIX + delegator + operator)
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def delegate(self, delegator: bytes, operator: bytes, amount: int) -> None:
+        v = self.validator(operator)
+        if v is None:
+            raise ValueError(f"unknown validator {operator.hex()}")
+        self.bank.send(delegator, BONDED_POOL, amount)
+        v.tokens += amount
+        self.set_validator(v)
+        self.store.set(
+            _DEL_PREFIX + delegator + operator,
+            (self.delegation(delegator, operator) + amount).to_bytes(16, "big"),
+        )
+
+    def undelegate(self, delegator: bytes, operator: bytes, amount: int) -> None:
+        """Begin unbonding; tokens move to the not-bonded pool immediately
+        (unbonding period bookkeeping is tracked by consumers via hooks)."""
+        v = self.validator(operator)
+        if v is None:
+            raise ValueError(f"unknown validator {operator.hex()}")
+        cur = self.delegation(delegator, operator)
+        if cur < amount:
+            raise ValueError("undelegate amount exceeds delegation")
+        self.store.set(
+            _DEL_PREFIX + delegator + operator, (cur - amount).to_bytes(16, "big")
+        )
+        v.tokens -= amount
+        self.set_validator(v)
+        self.bank.send(BONDED_POOL, NOT_BONDED_POOL, amount)
+        # delegator claim tracked out-of-band; release at maturity not modeled
+        for hook in self.hooks_after_unbonding_initiated:
+            hook(operator)
+
+    def powers_snapshot(self) -> Dict[bytes, int]:
+        return {v.operator: v.power for v in self.bonded_validators()}

@@ -1,0 +1,151 @@
+"""Versioned key-value multistore with Merkle app hash.
+
+Role parity with the reference's IAVL/LevelDB commit-multistore (SURVEY.md
+§2.1 "framework": baseapp stores): namespaced substores per module, branch/
+cache-wrap semantics for speculative execution (CheckTx / proposal
+processing), commit-per-height versioning with app-hash, load-at-height
+rollback, and full export/import for genesis and state-sync-style snapshots.
+
+Implementation is an in-memory copy-on-write dict (this framework's node is
+a library/devnet runtime, not a disk daemon yet); the app hash is a
+deterministic SHA-256 over sorted (store, key, value) triples so every
+validator computes the identical hash for identical state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class KVStore:
+    """A single namespaced store view backed by a dict."""
+
+    def __init__(self, data: Dict[bytes, bytes]):
+        self._data = data
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if not isinstance(key, bytes) or not isinstance(value, bytes):
+            raise TypeError("keys and values must be bytes")
+        self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        self._data.pop(key, None)
+
+    def has(self, key: bytes) -> bool:
+        return key in self._data
+
+    def iterate(self, prefix: bytes = b"") -> Iterator[Tuple[bytes, bytes]]:
+        """Deterministic (sorted) iteration over keys with the prefix."""
+        for k in sorted(self._data):
+            if k.startswith(prefix):
+                yield k, self._data[k]
+
+
+class MultiStore:
+    """Named substores + commit versioning.
+
+    ``branch()`` returns a deep-copied speculative store (the SDK's
+    CacheMultiStore used by CheckTx and proposal handling); ``commit()``
+    seals a version and returns the app hash.
+    """
+
+    def __init__(self, store_names: List[str]):
+        self._names = list(store_names)
+        self._stores: Dict[str, Dict[bytes, bytes]] = {n: {} for n in store_names}
+        self._versions: List[Tuple[int, Dict[str, Dict[bytes, bytes]], bytes]] = []
+        self._last_height = 0
+
+    def store(self, name: str) -> KVStore:
+        if name not in self._stores:
+            raise KeyError(f"unknown store {name!r}")
+        return KVStore(self._stores[name])
+
+    @property
+    def store_names(self) -> List[str]:
+        return list(self._names)
+
+    def ensure_store(self, name: str) -> None:
+        """Mount a new substore (upgrade-time store additions)."""
+        if name not in self._stores:
+            self._names.append(name)
+            self._stores[name] = {}
+
+    # --- branching --------------------------------------------------------
+
+    def branch(self) -> "MultiStore":
+        ms = MultiStore(self._names)
+        ms._stores = {n: dict(d) for n, d in self._stores.items()}
+        ms._last_height = self._last_height
+        return ms
+
+    def write_back(self, branched: "MultiStore") -> None:
+        """Apply a branched store's state over this one (ante success path)."""
+        self._stores = {n: dict(d) for n, d in branched._stores.items()}
+
+    # --- commit / versions ------------------------------------------------
+
+    def app_hash(self) -> bytes:
+        h = hashlib.sha256()
+        for name in sorted(self._stores):
+            data = self._stores[name]
+            for k in sorted(data):
+                h.update(hashlib.sha256(name.encode() + b"\x00" + k).digest())
+                h.update(hashlib.sha256(data[k]).digest())
+        return h.digest()
+
+    def commit(self, height: int) -> bytes:
+        if height <= self._last_height:
+            raise ValueError(
+                f"commit height {height} must be > last committed {self._last_height}"
+            )
+        snapshot = {n: dict(d) for n, d in self._stores.items()}
+        ah = self.app_hash()
+        self._versions.append((height, snapshot, ah))
+        self._last_height = height
+        return ah
+
+    @property
+    def last_height(self) -> int:
+        return self._last_height
+
+    def prune(self, keep_recent: int) -> None:
+        if keep_recent > 0 and len(self._versions) > keep_recent:
+            self._versions = self._versions[-keep_recent:]
+
+    def load_height(self, height: int) -> None:
+        """Roll the working state back to a committed version
+        (app.LoadHeight parity, app/app.go:729)."""
+        for h, snap, _ in self._versions:
+            if h == height:
+                self._stores = {n: dict(d) for n, d in snap.items()}
+                self._last_height = h
+                # drop newer versions
+                self._versions = [v for v in self._versions if v[0] <= height]
+                return
+        raise KeyError(f"no committed version at height {height}")
+
+    def committed_hash(self, height: int) -> bytes:
+        for h, _, ah in self._versions:
+            if h == height:
+                return ah
+        raise KeyError(f"no committed version at height {height}")
+
+    # --- export / import (genesis + snapshots) ----------------------------
+
+    def export(self) -> Dict[str, Dict[str, str]]:
+        """JSON-able dump of all stores (hex keys/values)."""
+        return {
+            n: {k.hex(): v.hex() for k, v in sorted(d.items())}
+            for n, d in self._stores.items()
+        }
+
+    @classmethod
+    def import_state(cls, dump: Dict[str, Dict[str, str]]) -> "MultiStore":
+        ms = cls(sorted(dump))
+        for n, d in dump.items():
+            ms._stores[n] = {bytes.fromhex(k): bytes.fromhex(v) for k, v in d.items()}
+        return ms

@@ -1,0 +1,378 @@
+"""Transaction codec: messages, fees, sign bytes, signatures.
+
+This framework's equivalent of the reference's SDK tx layer
+(app/encoding/encoding.go MakeConfig + SIGN_MODE_DIRECT signing used by
+pkg/user/signer.go:507-562).  Wire format is a deterministic length-prefixed
+binary encoding (not protobuf — one canonical byte representation, no
+map/ordering pitfalls); sign bytes cover body + auth info + chain id, so
+fee, gas, sequence and chain are all signature-protected.
+
+Message set mirrors the reference's state-machine surface (SURVEY.md §2.1):
+bank send, x/blob MsgPayForBlobs, x/upgrade signal/try-upgrade, x/blobstream
+EVM-address registration, staking delegate/undelegate, and a gov-gated param
+change (x/paramfilter's enforcement point).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
+
+from celestia_tpu.da.namespace import Namespace
+from celestia_tpu.da.shares import _read_varint, _varint
+from celestia_tpu.utils.secp256k1 import PrivateKey, PublicKey
+
+ADDRESS_SIZE = 20
+
+
+def _put_bytes(out: bytearray, b: bytes):
+    out += _varint(len(b))
+    out += b
+
+
+def _get_bytes(raw: bytes, pos: int) -> Tuple[bytes, int]:
+    n, pos = _read_varint(raw, pos)
+    if pos + n > len(raw):
+        raise ValueError("truncated bytes field")
+    return raw[pos : pos + n], pos + n
+
+
+# ---------------------------------------------------------------------------
+# Messages
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MsgSend:
+    """x/bank transfer (the reference's most common non-blob tx)."""
+
+    from_addr: bytes
+    to_addr: bytes
+    amount: int  # utia
+
+    TYPE = 1
+
+    def signers(self) -> List[bytes]:
+        return [self.from_addr]
+
+
+@dataclass(frozen=True)
+class MsgPayForBlobs:
+    """x/blob MsgPayForBlobs (x/blob/types/payforblob.go:49-146 parity):
+    pays for blob inclusion; blobs themselves never touch state."""
+
+    signer: bytes
+    namespaces: Tuple[bytes, ...]  # 29-byte raw namespaces
+    blob_sizes: Tuple[int, ...]
+    share_commitments: Tuple[bytes, ...]  # 32-byte commitments
+    share_versions: Tuple[int, ...]
+
+    TYPE = 2
+
+    def signers(self) -> List[bytes]:
+        return [self.signer]
+
+
+@dataclass(frozen=True)
+class MsgSignalVersion:
+    """x/upgrade: validator signals readiness for an app version."""
+
+    validator: bytes
+    version: int
+
+    TYPE = 3
+
+    def signers(self) -> List[bytes]:
+        return [self.validator]
+
+
+@dataclass(frozen=True)
+class MsgTryUpgrade:
+    """x/upgrade: tally signals; upgrade if >= 5/6 of power signalled."""
+
+    signer: bytes
+
+    TYPE = 4
+
+    def signers(self) -> List[bytes]:
+        return [self.signer]
+
+
+@dataclass(frozen=True)
+class MsgRegisterEVMAddress:
+    """x/blobstream: validator registers its EVM orchestrator address."""
+
+    validator: bytes
+    evm_address: bytes  # 20 bytes
+
+    TYPE = 5
+
+    def signers(self) -> List[bytes]:
+        return [self.validator]
+
+
+@dataclass(frozen=True)
+class MsgDelegate:
+    delegator: bytes
+    validator: bytes
+    amount: int
+
+    TYPE = 6
+
+    def signers(self) -> List[bytes]:
+        return [self.delegator]
+
+
+@dataclass(frozen=True)
+class MsgUndelegate:
+    delegator: bytes
+    validator: bytes
+    amount: int
+
+    TYPE = 7
+
+    def signers(self) -> List[bytes]:
+        return [self.delegator]
+
+
+@dataclass(frozen=True)
+class MsgParamChange:
+    """Governance parameter change; x/paramfilter blocks hardfork-only params."""
+
+    authority: bytes
+    subspace: str
+    key: str
+    value: bytes
+
+    TYPE = 8
+
+    def signers(self) -> List[bytes]:
+        return [self.authority]
+
+
+Msg = Union[
+    MsgSend,
+    MsgPayForBlobs,
+    MsgSignalVersion,
+    MsgTryUpgrade,
+    MsgRegisterEVMAddress,
+    MsgDelegate,
+    MsgUndelegate,
+    MsgParamChange,
+]
+
+_MSG_TYPES = {
+    cls.TYPE: cls
+    for cls in (
+        MsgSend,
+        MsgPayForBlobs,
+        MsgSignalVersion,
+        MsgTryUpgrade,
+        MsgRegisterEVMAddress,
+        MsgDelegate,
+        MsgUndelegate,
+        MsgParamChange,
+    )
+}
+
+
+def marshal_msg(msg: Msg) -> bytes:
+    out = bytearray()
+    out += _varint(msg.TYPE)
+    if isinstance(msg, MsgSend):
+        _put_bytes(out, msg.from_addr)
+        _put_bytes(out, msg.to_addr)
+        out += _varint(msg.amount)
+    elif isinstance(msg, MsgPayForBlobs):
+        _put_bytes(out, msg.signer)
+        out += _varint(len(msg.namespaces))
+        for ns, size, comm, ver in zip(
+            msg.namespaces, msg.blob_sizes, msg.share_commitments, msg.share_versions
+        ):
+            _put_bytes(out, ns)
+            out += _varint(size)
+            _put_bytes(out, comm)
+            out += _varint(ver)
+    elif isinstance(msg, MsgSignalVersion):
+        _put_bytes(out, msg.validator)
+        out += _varint(msg.version)
+    elif isinstance(msg, MsgTryUpgrade):
+        _put_bytes(out, msg.signer)
+    elif isinstance(msg, MsgRegisterEVMAddress):
+        _put_bytes(out, msg.validator)
+        _put_bytes(out, msg.evm_address)
+    elif isinstance(msg, (MsgDelegate, MsgUndelegate)):
+        _put_bytes(out, msg.delegator)
+        _put_bytes(out, msg.validator)
+        out += _varint(msg.amount)
+    elif isinstance(msg, MsgParamChange):
+        _put_bytes(out, msg.authority)
+        _put_bytes(out, msg.subspace.encode())
+        _put_bytes(out, msg.key.encode())
+        _put_bytes(out, msg.value)
+    else:
+        raise TypeError(f"unknown msg type {type(msg)}")
+    return bytes(out)
+
+
+def unmarshal_msg(raw: bytes, pos: int = 0) -> Tuple[Msg, int]:
+    t, pos = _read_varint(raw, pos)
+    if t == MsgSend.TYPE:
+        frm, pos = _get_bytes(raw, pos)
+        to, pos = _get_bytes(raw, pos)
+        amt, pos = _read_varint(raw, pos)
+        return MsgSend(frm, to, amt), pos
+    if t == MsgPayForBlobs.TYPE:
+        signer, pos = _get_bytes(raw, pos)
+        n, pos = _read_varint(raw, pos)
+        nss, sizes, comms, vers = [], [], [], []
+        for _ in range(n):
+            ns, pos = _get_bytes(raw, pos)
+            size, pos = _read_varint(raw, pos)
+            comm, pos = _get_bytes(raw, pos)
+            ver, pos = _read_varint(raw, pos)
+            nss.append(ns)
+            sizes.append(size)
+            comms.append(comm)
+            vers.append(ver)
+        return (
+            MsgPayForBlobs(
+                signer, tuple(nss), tuple(sizes), tuple(comms), tuple(vers)
+            ),
+            pos,
+        )
+    if t == MsgSignalVersion.TYPE:
+        val, pos = _get_bytes(raw, pos)
+        ver, pos = _read_varint(raw, pos)
+        return MsgSignalVersion(val, ver), pos
+    if t == MsgTryUpgrade.TYPE:
+        signer, pos = _get_bytes(raw, pos)
+        return MsgTryUpgrade(signer), pos
+    if t == MsgRegisterEVMAddress.TYPE:
+        val, pos = _get_bytes(raw, pos)
+        evm, pos = _get_bytes(raw, pos)
+        return MsgRegisterEVMAddress(val, evm), pos
+    if t in (MsgDelegate.TYPE, MsgUndelegate.TYPE):
+        d, pos = _get_bytes(raw, pos)
+        v, pos = _get_bytes(raw, pos)
+        amt, pos = _read_varint(raw, pos)
+        cls = MsgDelegate if t == MsgDelegate.TYPE else MsgUndelegate
+        return cls(d, v, amt), pos
+    if t == MsgParamChange.TYPE:
+        auth, pos = _get_bytes(raw, pos)
+        sub, pos = _get_bytes(raw, pos)
+        key, pos = _get_bytes(raw, pos)
+        val, pos = _get_bytes(raw, pos)
+        return MsgParamChange(auth, sub.decode(), key.decode(), val), pos
+    raise ValueError(f"unknown msg type id {t}")
+
+
+# ---------------------------------------------------------------------------
+# Tx
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Fee:
+    amount: int  # utia
+    gas_limit: int
+
+    def gas_price(self) -> float:
+        return self.amount / self.gas_limit if self.gas_limit else 0.0
+
+
+@dataclass(frozen=True)
+class Tx:
+    msgs: Tuple[Msg, ...]
+    fee: Fee
+    pubkey: bytes  # 33-byte compressed secp256k1
+    sequence: int
+    account_number: int
+    memo: str = ""
+    signature: bytes = b""
+
+    def body_bytes(self) -> bytes:
+        out = bytearray()
+        out += _varint(len(self.msgs))
+        for m in self.msgs:
+            _put_bytes(out, marshal_msg(m))
+        _put_bytes(out, self.memo.encode())
+        return bytes(out)
+
+    def auth_bytes(self) -> bytes:
+        out = bytearray()
+        out += _varint(self.fee.amount)
+        out += _varint(self.fee.gas_limit)
+        _put_bytes(out, self.pubkey)
+        out += _varint(self.sequence)
+        out += _varint(self.account_number)
+        return bytes(out)
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        out = bytearray()
+        _put_bytes(out, chain_id.encode())
+        _put_bytes(out, self.body_bytes())
+        _put_bytes(out, self.auth_bytes())
+        return hashlib.sha256(bytes(out)).digest()
+
+    def signed(self, priv: PrivateKey, chain_id: str) -> "Tx":
+        sig = priv.sign(self.sign_bytes(chain_id))
+        return Tx(
+            self.msgs, self.fee, self.pubkey, self.sequence,
+            self.account_number, self.memo, sig,
+        )
+
+    def verify_signature(self, chain_id: str) -> bool:
+        try:
+            pk = PublicKey.from_compressed(self.pubkey)
+        except ValueError:
+            return False
+        return pk.verify(self.sign_bytes(chain_id), self.signature)
+
+    def signer_address(self) -> bytes:
+        return PublicKey.from_compressed(self.pubkey).address()
+
+    def marshal(self) -> bytes:
+        out = bytearray()
+        _put_bytes(out, self.body_bytes())
+        _put_bytes(out, self.auth_bytes())
+        _put_bytes(out, self.signature)
+        return bytes(out)
+
+    def hash(self) -> bytes:
+        return hashlib.sha256(self.marshal()).digest()
+
+
+def unmarshal_tx(raw: bytes) -> Tx:
+    body, pos = _get_bytes(raw, 0)
+    auth, pos = _get_bytes(raw, pos)
+    sig, pos = _get_bytes(raw, pos)
+    if pos != len(raw):
+        raise ValueError("trailing bytes after tx")
+    # body
+    bpos = 0
+    n_msgs, bpos = _read_varint(body, bpos)
+    msgs = []
+    for _ in range(n_msgs):
+        mraw, bpos = _get_bytes(body, bpos)
+        msg, used = unmarshal_msg(mraw)
+        if used != len(mraw):
+            raise ValueError("trailing bytes in msg")
+        msgs.append(msg)
+    memo_b, bpos = _get_bytes(body, bpos)
+    if bpos != len(body):
+        raise ValueError("trailing bytes in tx body")
+    # auth
+    apos = 0
+    fee_amount, apos = _read_varint(auth, apos)
+    gas_limit, apos = _read_varint(auth, apos)
+    pubkey, apos = _get_bytes(auth, apos)
+    sequence, apos = _read_varint(auth, apos)
+    account_number, apos = _read_varint(auth, apos)
+    if apos != len(auth):
+        raise ValueError("trailing bytes in tx auth")
+    return Tx(
+        tuple(msgs), Fee(fee_amount, gas_limit), pubkey, sequence,
+        account_number, memo_b.decode(), sig,
+    )

@@ -1,0 +1,155 @@
+"""secp256k1 ECDSA (pure Python) — tx signing/verification primitives.
+
+Equivalent role to the reference's decred secp256k1 dependency
+(SURVEY.md §2.2 "BLS / secp256k1 / SHA"): account-key signatures over
+SIGN_MODE_DIRECT-style sign bytes.  Deterministic nonces per RFC 6979 so
+signing is reproducible.  Pure Python is adequate for the host-side tx path
+(the device does the DA compute); a native C++ path can slot in behind the
+same interface later.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+# Curve parameters (SEC2 secp256k1)
+P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+Gx = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+Gy = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, -1, m)
+
+
+def _point_add(p1: Optional[Tuple[int, int]], p2: Optional[Tuple[int, int]]):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2 and (y1 + y2) % P == 0:
+        return None
+    if p1 == p2:
+        lam = (3 * x1 * x1) * _inv(2 * y1, P) % P
+    else:
+        lam = (y2 - y1) * _inv(x2 - x1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def _point_mul(k: int, point: Tuple[int, int]):
+    result = None
+    addend = point
+    while k:
+        if k & 1:
+            result = _point_add(result, addend)
+        addend = _point_add(addend, addend)
+        k >>= 1
+    return result
+
+
+@dataclass(frozen=True)
+class PrivateKey:
+    d: int
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "PrivateKey":
+        """Derive a valid key deterministically from arbitrary seed bytes."""
+        d = 0
+        counter = 0
+        while not 1 <= d < N:
+            d = int.from_bytes(
+                hashlib.sha256(seed + counter.to_bytes(4, "big")).digest(), "big"
+            )
+            counter += 1
+        return cls(d)
+
+    def public_key(self) -> "PublicKey":
+        x, y = _point_mul(self.d, (Gx, Gy))
+        return PublicKey(x, y)
+
+    def sign(self, msg: bytes) -> bytes:
+        """Deterministic ECDSA (RFC 6979, SHA-256); 64-byte r||s, low-s."""
+        z = int.from_bytes(hashlib.sha256(msg).digest(), "big") % N
+        k = _rfc6979_k(self.d, hashlib.sha256(msg).digest())
+        while True:
+            R = _point_mul(k, (Gx, Gy))
+            r = R[0] % N
+            if r == 0:
+                k = (k + 1) % N
+                continue
+            s = _inv(k, N) * (z + r * self.d) % N
+            if s == 0:
+                k = (k + 1) % N
+                continue
+            if s > N // 2:  # canonical low-s
+                s = N - s
+            return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def _rfc6979_k(d: int, h1: bytes) -> int:
+    x = d.to_bytes(32, "big")
+    V = b"\x01" * 32
+    K = b"\x00" * 32
+    K = hmac.new(K, V + b"\x00" + x + h1, hashlib.sha256).digest()
+    V = hmac.new(K, V, hashlib.sha256).digest()
+    K = hmac.new(K, V + b"\x01" + x + h1, hashlib.sha256).digest()
+    V = hmac.new(K, V, hashlib.sha256).digest()
+    while True:
+        V = hmac.new(K, V, hashlib.sha256).digest()
+        k = int.from_bytes(V, "big")
+        if 1 <= k < N:
+            return k
+        K = hmac.new(K, V + b"\x00", hashlib.sha256).digest()
+        V = hmac.new(K, V, hashlib.sha256).digest()
+
+
+@dataclass(frozen=True)
+class PublicKey:
+    x: int
+    y: int
+
+    def compressed(self) -> bytes:
+        return bytes([2 + (self.y & 1)]) + self.x.to_bytes(32, "big")
+
+    @classmethod
+    def from_compressed(cls, raw: bytes) -> "PublicKey":
+        if len(raw) != 33 or raw[0] not in (2, 3):
+            raise ValueError("invalid compressed pubkey")
+        x = int.from_bytes(raw[1:], "big")
+        if x >= P:
+            raise ValueError("pubkey x out of range")
+        y2 = (pow(x, 3, P) + 7) % P
+        y = pow(y2, (P + 1) // 4, P)
+        if y * y % P != y2:
+            raise ValueError("point not on curve")
+        if (y & 1) != (raw[0] & 1):
+            y = P - y
+        return cls(x, y)
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != 64:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (1 <= r < N and 1 <= s < N):
+            return False
+        z = int.from_bytes(hashlib.sha256(msg).digest(), "big") % N
+        w = _inv(s, N)
+        u1 = z * w % N
+        u2 = r * w % N
+        pt = _point_add(_point_mul(u1, (Gx, Gy)), _point_mul(u2, (self.x, self.y)))
+        if pt is None:
+            return False
+        return pt[0] % N == r
+
+    def address(self) -> bytes:
+        """20-byte account address: sha256(compressed pubkey)[:20]."""
+        return hashlib.sha256(self.compressed()).digest()[:20]

@@ -3,13 +3,30 @@
 Multi-chip hardware is not available in CI; sharding correctness is validated
 on XLA's host platform with 8 virtual devices (the driver separately dry-runs
 the multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: this environment's sitecustomize registers the 'axon' TPU tunnel and
+forces jax_platforms to it, ignoring the JAX_PLATFORMS env var — so we both
+set the env (for spawned subprocesses) and override the jax config directly.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: the SHA/NMT pipelines are compile-heavy and
+# shapes repeat across runs; this turns rerun compile time into a disk read.
+jax.config.update("jax_compilation_cache_dir", "/tmp/celestia_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+assert len(jax.devices()) == 8, (
+    f"tests expect 8 virtual CPU devices, got {jax.devices()}"
+)

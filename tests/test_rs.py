@@ -135,3 +135,37 @@ def test_repair_insufficient_raises():
     avail[0, 0] = True
     with pytest.raises(ValueError, match="stalled"):
         rs.repair_square(eds, avail)
+
+
+def test_repair_detects_byzantine_shares():
+    """A tampered available share that breaks codeword consistency must raise
+    ByzantineError (rsmt2d ErrByzantine parity), not silently 'repair'."""
+    rng = np.random.default_rng(21)
+    k = 4
+    square = rng.integers(0, 256, (k, k, 16), dtype=np.uint8)
+    eds = np.asarray(rs.extend_square(square))
+    avail = np.ones((2 * k, 2 * k), dtype=bool)
+    avail[0, :k] = False  # force row 0 to be solved from its parity half
+    bad = eds.copy()
+    bad[0, k] ^= 1  # tamper an available parity share in the solved row
+    with pytest.raises(rs.ByzantineError):
+        rs.repair_square(bad, avail)
+
+
+def test_repair_detects_byzantine_full_row():
+    """Inconsistent but fully-available axes (never solved) are also caught."""
+    rng = np.random.default_rng(22)
+    k = 4
+    square = rng.integers(0, 256, (k, k, 16), dtype=np.uint8)
+    eds = np.asarray(rs.extend_square(square))
+    avail = np.ones((2 * k, 2 * k), dtype=bool)
+    avail[1, 0] = False  # something to repair so the loop runs
+    bad = eds.copy()
+    bad[k + 1, k + 1] ^= 0x10  # tamper a fully-available parity cell
+    with pytest.raises(rs.ByzantineError):
+        rs.repair_square(bad, avail)
+
+
+def test_extend_batched_validates_shape():
+    with pytest.raises(ValueError, match="power of two"):
+        rs.extend_squares_batched(np.zeros((2, 3, 3, 16), dtype=np.uint8))

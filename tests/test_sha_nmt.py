@@ -1,0 +1,144 @@
+"""SHA-256 / NMT / RFC-6962 kernel tests vs independent hashlib references."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from celestia_tpu.appconsts import NAMESPACE_SIZE, SHARE_SIZE
+from celestia_tpu.da.namespace import PARITY_SHARE_NAMESPACE, Namespace
+from celestia_tpu.ops import nmt as nmt_ops
+from celestia_tpu.ops import rs
+from celestia_tpu.ops.sha256 import sha256_np
+
+
+@pytest.mark.parametrize("length", [0, 1, 55, 56, 63, 64, 65, 181, 542, 1000])
+def test_sha256_matches_hashlib(length):
+    rng = np.random.default_rng(length)
+    msgs = rng.integers(0, 256, (5, length), dtype=np.uint8)
+    got = sha256_np(msgs)
+    for i in range(5):
+        want = hashlib.sha256(msgs[i].tobytes()).digest()
+        assert got[i].tobytes() == want, f"mismatch at len={length} i={i}"
+
+
+def test_sha256_batch_shapes():
+    rng = np.random.default_rng(0)
+    msgs = rng.integers(0, 256, (2, 3, 4, 100), dtype=np.uint8)
+    got = sha256_np(msgs)
+    assert got.shape == (2, 3, 4, 32)
+    assert got[1, 2, 3].tobytes() == hashlib.sha256(msgs[1, 2, 3].tobytes()).digest()
+
+
+# --- host-side NMT reference (independent implementation of the spec) -------
+
+_MAX_NS = b"\xff" * NAMESPACE_SIZE
+
+
+def _ref_leaf(ndata: bytes):
+    ns = ndata[:NAMESPACE_SIZE]
+    return ns, ns, hashlib.sha256(b"\x00" + ndata).digest()
+
+
+def _ref_node(l, r):
+    l_min, l_max, l_h = l
+    r_min, r_max, r_h = r
+    max_ns = l_max if r_min == _MAX_NS else r_max
+    h = hashlib.sha256(b"\x01" + l_min + l_max + l_h + r_min + r_max + r_h).digest()
+    return l_min, max_ns, h
+
+
+def _ref_nmt_root(leaves):
+    nodes = [_ref_leaf(x) for x in leaves]
+    while len(nodes) > 1:
+        nodes = [_ref_node(nodes[i], nodes[i + 1]) for i in range(0, len(nodes), 2)]
+    m, M, h = nodes[0]
+    return m + M + h
+
+
+def test_nmt_root_matches_reference():
+    rng = np.random.default_rng(1)
+    # 8 leaves: 4 with ordered namespaces, 4 parity
+    leaves = []
+    for i in range(4):
+        ns = Namespace.v0(bytes([i + 1])).raw
+        leaves.append(ns + rng.integers(0, 256, 64, dtype=np.uint8).tobytes())
+    for _ in range(4):
+        leaves.append(
+            PARITY_SHARE_NAMESPACE.raw + rng.integers(0, 256, 64, dtype=np.uint8).tobytes()
+        )
+    arr = np.stack([np.frombuffer(x, dtype=np.uint8) for x in leaves])
+    got = np.asarray(nmt_ops.nmt_roots(arr))
+    want = np.frombuffer(_ref_nmt_root(leaves), dtype=np.uint8)
+    assert np.array_equal(got, want)
+    # ignore-max-namespace: the root's max ns is the largest NON-parity ns
+    assert got[:NAMESPACE_SIZE].tobytes() == leaves[0][:NAMESPACE_SIZE]
+    assert got[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE].tobytes() == leaves[3][:NAMESPACE_SIZE]
+
+
+def test_nmt_all_parity_root():
+    rng = np.random.default_rng(2)
+    leaves = [
+        PARITY_SHARE_NAMESPACE.raw + rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+        for _ in range(4)
+    ]
+    arr = np.stack([np.frombuffer(x, dtype=np.uint8) for x in leaves])
+    got = np.asarray(nmt_ops.nmt_roots(arr))
+    want = np.frombuffer(_ref_nmt_root(leaves), dtype=np.uint8)
+    assert np.array_equal(got, want)
+    assert got[: 2 * NAMESPACE_SIZE].tobytes() == _MAX_NS * 2
+
+
+def test_eds_nmt_roots_small_square():
+    """Full pipeline check on a 2x2 original square vs host reference."""
+    rng = np.random.default_rng(3)
+    k = 2
+    # realistic shares: namespace-prefixed share bytes with increasing ns
+    square = np.zeros((k, k, SHARE_SIZE), dtype=np.uint8)
+    for r in range(k):
+        for c in range(k):
+            ns = Namespace.v0(bytes([r * k + c + 1])).raw
+            body = rng.integers(0, 256, SHARE_SIZE - NAMESPACE_SIZE, dtype=np.uint8)
+            square[r, c] = np.frombuffer(ns + body.tobytes(), dtype=np.uint8)
+    eds = np.asarray(rs.extend_square(square))
+    roots = np.asarray(nmt_ops.eds_nmt_roots(eds))
+    assert roots.shape == (2, 2 * k, nmt_ops.NMT_DIGEST_SIZE)
+
+    def ref_axis_root(cells, axis_idx, axis_is_row):
+        leaves = []
+        for j, cell in enumerate(cells):
+            r, c = (axis_idx, j) if axis_is_row else (j, axis_idx)
+            if r < k and c < k:
+                prefix = bytes(cell[:NAMESPACE_SIZE])
+            else:
+                prefix = PARITY_SHARE_NAMESPACE.raw
+            leaves.append(prefix + bytes(cell))
+        return np.frombuffer(_ref_nmt_root(leaves), dtype=np.uint8)
+
+    for r in range(2 * k):
+        want = ref_axis_root(eds[r], r, True)
+        assert np.array_equal(roots[0, r], want), f"row {r} mismatch"
+    for c in range(2 * k):
+        want = ref_axis_root(eds[:, c], c, False)
+        assert np.array_equal(roots[1, c], want), f"col {c} mismatch"
+
+
+def test_rfc6962_pow2_matches_reference():
+    rng = np.random.default_rng(4)
+    leaves = rng.integers(0, 256, (8, 90), dtype=np.uint8)
+    got = np.asarray(nmt_ops.rfc6962_root_pow2(leaves))
+    want = nmt_ops.rfc6962_root_np([leaves[i].tobytes() for i in range(8)])
+    assert np.array_equal(got, want)
+
+
+def test_rfc6962_known_vector():
+    # RFC 6962 test vector: single leaf "" -> sha256(0x00)
+    want = hashlib.sha256(b"\x00").digest()
+    got = nmt_ops.rfc6962_root_np([b""])
+    assert got.tobytes() == want
+
+
+def test_empty_root():
+    er = nmt_ops.empty_root_np()
+    assert er[: 2 * NAMESPACE_SIZE].tobytes() == b"\x00" * 58
+    assert er[2 * NAMESPACE_SIZE :].tobytes() == hashlib.sha256(b"").digest()

@@ -8,7 +8,7 @@ import pytest
 
 from celestia_tpu.da import namespace as ns
 from celestia_tpu.da import square as sq
-from celestia_tpu.da.blob import Blob, BlobTx, unmarshal_index_wrapper
+from celestia_tpu.da.blob import Blob, BlobTx
 from celestia_tpu.da.namespace import (
     PAY_FOR_BLOB_NAMESPACE,
     PRIMARY_RESERVED_PADDING_NAMESPACE,

@@ -191,7 +191,12 @@ def parse_sparse_shares(shares: Sequence[Share]) -> List[Tuple[Namespace, bytes]
 
 
 def _varint(n: int) -> bytes:
-    """Unsigned LEB128 varint (protobuf-style), as used for tx unit delimiters."""
+    """Unsigned LEB128 varint (protobuf-style), as used for tx unit delimiters.
+
+    Values are bounded to uint64 — symmetric with :func:`_read_varint`.
+    """
+    if n < 0 or n >= 1 << 64:
+        raise ValueError(f"varint value out of uint64 range: {n}")
     out = bytearray()
     while True:
         b = n & 0x7F
@@ -213,9 +218,11 @@ def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
         pos += 1
         result |= (b & 0x7F) << shift
         if not b & 0x80:
+            if result >= 1 << 64:
+                raise ValueError("varint exceeds uint64 range")
             return result, pos
         shift += 7
-        if shift > 35:
+        if shift > 63:
             raise ValueError("varint too long")
 
 

@@ -1,0 +1,224 @@
+"""In-process single-node network: the workhorse test/devnet driver.
+
+Parity with /root/reference/test/util/testnode/ (full_node.go:20-49 spins a
+consensus node + app in one process via a local ABCI client; network.go:19-69
++ node_interaction_api.go:40-151 provide the fluent config, funded accounts
+and WaitForHeight/PostData helpers).  Here the consensus engine is an
+in-process block-production loop that drives the App's ABCI surface exactly
+the way celestia-core does: reap mempool by priority -> PrepareProposal ->
+ProcessProposal (every block self-validated, so a Prepare/Process divergence
+fails loudly) -> finalize + commit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time as _time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from celestia_tpu.appconsts import (
+    CONTINUATION_SPARSE_SHARE_CONTENT_SIZE,
+    GOAL_BLOCK_TIME_SECONDS,
+)
+from celestia_tpu.client.signer import SubmitResult
+from celestia_tpu.da.blob import unmarshal_blob_tx
+from celestia_tpu.node.mempool import Mempool
+from celestia_tpu.state.ante import AnteContext, AnteError, run_ante
+from celestia_tpu.state.app import App, TxResult
+from celestia_tpu.state.auth import AccountKeeper
+from celestia_tpu.state.bank import BankKeeper
+from celestia_tpu.state.params import ParamsKeeper
+from celestia_tpu.state.tx import unmarshal_tx
+from celestia_tpu.utils.secp256k1 import PrivateKey
+
+
+@dataclass
+class BlockHeader:
+    height: int
+    time_ns: int
+    chain_id: str
+    app_version: int
+    data_hash: bytes
+    app_hash: bytes  # state root AFTER this block
+    square_size: int
+
+
+@dataclass
+class Block:
+    header: BlockHeader
+    txs: List[bytes]
+    tx_results: List[TxResult] = field(default_factory=list)
+
+
+class TestNode:
+    """Single-process node exposing the client surface the Signer needs."""
+
+    __test__ = False  # not a pytest class
+
+    def __init__(
+        self,
+        chain_id: str = "celestia-tpu-devnet",
+        funded_accounts: Optional[List[Tuple[PrivateKey, int]]] = None,
+        genesis_time_ns: Optional[int] = None,
+        block_interval_ns: int = GOAL_BLOCK_TIME_SECONDS * 10**9,
+        auto_produce: bool = True,
+        **app_kwargs,
+    ):
+        self.app = App(chain_id=chain_id, **app_kwargs)
+        self.chain_id = chain_id
+        self.block_interval_ns = block_interval_ns
+        self.auto_produce = auto_produce
+        max_bytes = (
+            self.app.max_effective_square_size() ** 2
+            * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+        )
+        self.mempool = Mempool(max_tx_bytes=max_bytes)
+        self.blocks: List[Block] = []
+        self._tx_index: Dict[bytes, dict] = {}
+        genesis = {
+            "chain_id": chain_id,
+            "genesis_time_ns": genesis_time_ns or _time.time_ns(),
+            "accounts": [],
+            "validators": [],
+        }
+        self._validator_key = PrivateKey.from_seed(b"testnode-validator")
+        val_addr = self._validator_key.public_key().address()
+        genesis["accounts"].append(
+            {"address": val_addr.hex(), "balance": 1_000_000_000_000}
+        )
+        genesis["validators"].append(
+            {"address": val_addr.hex(), "self_delegation": 100_000_000_000}
+        )
+        for key, balance in funded_accounts or []:
+            genesis["accounts"].append(
+                {"address": key.public_key().address().hex(), "balance": balance}
+            )
+        self.app.init_chain(genesis)
+        self._now_ns = self.app.genesis_time_ns
+
+    # ------------------------------------------------------------------
+    # client surface (what pkg/user's gRPC connection provides)
+    # ------------------------------------------------------------------
+
+    @property
+    def height(self) -> int:
+        return self.blocks[-1].header.height if self.blocks else 1
+
+    def account_info(self, address: bytes) -> Tuple[int, int]:
+        acc = self.app.accounts.get_or_create(address)
+        return acc.account_number, acc.sequence
+
+    def broadcast_tx(self, raw: bytes) -> SubmitResult:
+        """BroadcastMode_SYNC parity: CheckTx, then admit to the mempool."""
+        res = self.app.check_tx(raw)
+        tx_hash = hashlib.sha256(raw).digest()
+        if res.code != 0:
+            return SubmitResult(res.code, res.log, tx_hash)
+        btx = unmarshal_blob_tx(raw)
+        tx = unmarshal_tx(btx.tx if btx is not None else raw)
+        self.mempool.add(raw, tx.fee.gas_price(), self.height)
+        return SubmitResult(0, "", tx_hash)
+
+    def get_tx(self, tx_hash: bytes) -> Optional[dict]:
+        info = self._tx_index.get(tx_hash)
+        if info is None and self.auto_produce and len(self.mempool):
+            # emulate chain progress for poll-confirm clients: a pending
+            # mempool makes the (virtual) proposer cut the next block
+            self.produce_block()
+            info = self._tx_index.get(tx_hash)
+        return info
+
+    def simulate(self, raw: bytes) -> int:
+        """Gas estimation via simulated ante + 20% margin (signer.go
+        EstimateGas shape)."""
+        tx = unmarshal_tx(raw)
+        branch = self.app.store.branch()
+        ctx = AnteContext(
+            tx=tx,
+            raw_tx=raw,
+            accounts=AccountKeeper(branch.store("auth")),
+            bank=BankKeeper(branch.store("bank")),
+            params=ParamsKeeper(branch.store("params")),
+            chain_id=self.chain_id,
+            app_version=self.app.app_version,
+            simulate=True,
+        )
+        try:
+            meter = run_ante(ctx)
+            base = meter.consumed
+        except AnteError:
+            base = 100_000
+        return int(base * 1.2) + 100_000
+
+    # ------------------------------------------------------------------
+    # consensus loop
+    # ------------------------------------------------------------------
+
+    def produce_block(self) -> Block:
+        """One consensus round: reap -> Prepare -> Process -> finalize."""
+        height = self.height + 1
+        self._now_ns += self.block_interval_ns
+        time_ns = self._now_ns
+        mem_txs = self.mempool.reap()
+        proposal = self.app.prepare_proposal([t.raw for t in mem_txs])
+        accepted, reason = self.app.process_proposal(
+            proposal.block_txs, proposal.square_size, proposal.data_root
+        )
+        if not accepted:
+            raise RuntimeError(
+                f"node's own proposal rejected at height {height}: {reason}"
+            )
+        results, _end, app_hash = self.app.finalize_block(
+            proposal.block_txs, height, time_ns, proposal.data_root
+        )
+        header = BlockHeader(
+            height=height,
+            time_ns=time_ns,
+            chain_id=self.chain_id,
+            app_version=self.app.app_version,
+            data_hash=proposal.data_root,
+            app_hash=app_hash,
+            square_size=proposal.square_size,
+        )
+        block = Block(header, proposal.block_txs, results)
+        self.blocks.append(block)
+        # index included txs + drop them from the mempool
+        for raw, res in zip(proposal.block_txs, results):
+            h = hashlib.sha256(raw).digest()
+            self._tx_index[h] = {"code": res.code, "log": res.log, "height": height}
+            self.mempool.remove(h)
+        # txs the proposer dropped stay pooled until their TTL expires
+        self.mempool.evict_expired(height)
+        return block
+
+    def produce_blocks(self, n: int) -> List[Block]:
+        return [self.produce_block() for _ in range(n)]
+
+    def wait_for_height(self, h: int) -> None:
+        while self.height < h:
+            self.produce_block()
+
+    # ------------------------------------------------------------------
+    # queries (node_interaction_api.go helpers)
+    # ------------------------------------------------------------------
+
+    def block(self, height: int) -> Block:
+        for b in self.blocks:
+            if b.header.height == height:
+                return b
+        raise KeyError(f"no block at height {height}")
+
+    def data_root(self, height: int) -> bytes:
+        return self.block(height).header.data_hash
+
+    def fill_block(self, square_size: int, signer) -> SubmitResult:
+        """Post a blob sized to produce a square of ``square_size``
+        (node_interaction_api.go FillBlock)."""
+        from celestia_tpu.da.blob import Blob
+        from celestia_tpu.da.namespace import Namespace
+
+        n_shares = square_size * square_size // 2
+        size = (n_shares - 1) * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+        blob = Blob(Namespace.v0(b"fill"), b"\xaa" * max(size, 1))
+        return signer.submit_pay_for_blob([blob])

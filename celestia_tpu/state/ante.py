@@ -1,0 +1,251 @@
+"""Ante handler chain: tx admission checks run before execution.
+
+Parity with /root/reference/app/ante/ante.go:15-80 (the 18-decorator chain),
+adapted to this framework's tx model.  Order matters and mirrors the
+reference: panic guard (in the runner), msg version gatekeeper, basic
+validation, tx-size gas, fee checks (global min gas price from x/minfee,
+v2/app_consts.go:5-9) + fee deduction, signature verification against the
+account's pubkey/sequence/account-number, sequence increment, then the blob
+decorators (MinGasPFBDecorator ante/ante.go:14-48 and BlobShareDecorator
+ante/blob_share_decorator.go:17-70) and the gov param filter
+(x/paramfilter/gov_handler.go:36-60).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from celestia_tpu.appconsts import (
+    GLOBAL_MIN_GAS_PRICE,
+    SHARE_SIZE,
+    square_size_upper_bound,
+)
+from celestia_tpu.da.shares import sparse_shares_needed
+from celestia_tpu.da.square import subtree_width
+from celestia_tpu.state.bank import FEE_COLLECTOR
+from celestia_tpu.state.modules.blob import gas_to_consume
+from celestia_tpu.state.tx import (
+    MsgParamChange,
+    MsgPayForBlobs,
+    Tx,
+)
+from celestia_tpu.utils.secp256k1 import PublicKey
+
+TX_SIZE_COST_PER_BYTE = 10
+MAX_MEMO_CHARACTERS = 256
+MAX_TX_GAS = 50_000_000
+
+
+class AnteError(ValueError):
+    pass
+
+
+class OutOfGasError(AnteError):
+    pass
+
+
+class GasMeter:
+    def __init__(self, limit: int):
+        self.limit = limit
+        self.consumed = 0
+
+    def consume(self, amount: int, descriptor: str = "") -> None:
+        self.consumed += amount
+        if self.consumed > self.limit:
+            raise OutOfGasError(
+                f"out of gas: {descriptor} needs {self.consumed} > limit {self.limit}"
+            )
+
+
+@dataclass
+class AnteContext:
+    tx: Tx
+    raw_tx: bytes
+    accounts: "AccountKeeper"  # noqa: F821
+    bank: "BankKeeper"  # noqa: F821
+    params: "ParamsKeeper"  # noqa: F821
+    chain_id: str
+    app_version: int
+    gas_meter: GasMeter = None  # type: ignore[assignment]
+    is_check_tx: bool = False
+    is_recheck: bool = False
+    min_gas_price: float = 0.0  # node-local (CheckTx only)
+    simulate: bool = False
+
+    def __post_init__(self):
+        if self.gas_meter is None:
+            self.gas_meter = GasMeter(self.tx.fee.gas_limit)
+
+
+# --- decorators -------------------------------------------------------------
+
+
+def msg_gatekeeper(ctx: AnteContext) -> None:
+    """MsgVersioningGateKeeper (app/ante/msg_gatekeeper.go:1-57): messages
+    accepted depend on the app version (ADR-022 multi-version state machine)."""
+    from celestia_tpu.state.app_versions import msgs_accepted_at
+
+    accepted = msgs_accepted_at(ctx.app_version)
+    for m in ctx.tx.msgs:
+        if type(m) not in accepted:
+            raise AnteError(
+                f"message {type(m).__name__} not accepted at app version "
+                f"{ctx.app_version}"
+            )
+
+
+def validate_basic(ctx: AnteContext) -> None:
+    tx = ctx.tx
+    if not tx.msgs:
+        raise AnteError("tx has no messages")
+    if not tx.signature and not ctx.simulate:
+        raise AnteError("tx is unsigned")
+    if len(tx.memo) > MAX_MEMO_CHARACTERS:
+        raise AnteError(f"memo exceeds {MAX_MEMO_CHARACTERS} characters")
+    if tx.fee.gas_limit == 0:
+        raise AnteError("gas limit must be positive")
+    if tx.fee.gas_limit > MAX_TX_GAS:
+        raise AnteError(f"gas limit {tx.fee.gas_limit} exceeds max {MAX_TX_GAS}")
+    if tx.fee.amount < 0:
+        raise AnteError("fee must be non-negative")
+
+
+def consume_tx_size_gas(ctx: AnteContext) -> None:
+    ctx.gas_meter.consume(len(ctx.raw_tx) * TX_SIZE_COST_PER_BYTE, "tx size")
+
+
+def check_and_deduct_fee(ctx: AnteContext) -> None:
+    """ValidateTxFee + DeductFeeDecorator: enforce the network-wide min gas
+    price (x/minfee) and the node-local one (CheckTx), then move the fee to
+    the fee collector."""
+    tx = ctx.tx
+    network_min = ctx.params.get("minfee", "NetworkMinGasPrice", GLOBAL_MIN_GAS_PRICE)
+    required = tx.fee.gas_limit * network_min
+    if tx.fee.amount < required:
+        raise AnteError(
+            f"insufficient fee: got {tx.fee.amount}utia, required {required:.0f}utia "
+            f"(network min gas price {network_min})"
+        )
+    if ctx.is_check_tx and ctx.min_gas_price > 0:
+        local_required = tx.fee.gas_limit * ctx.min_gas_price
+        if tx.fee.amount < local_required:
+            raise AnteError(
+                f"insufficient fee for this node: got {tx.fee.amount}utia, "
+                f"required {local_required:.0f}utia (min gas price {ctx.min_gas_price})"
+            )
+    if ctx.simulate:
+        return
+    signer = tx.signer_address()
+    try:
+        ctx.bank.send(signer, FEE_COLLECTOR, tx.fee.amount)
+    except ValueError as e:
+        raise AnteError(f"fee deduction failed: {e}") from e
+
+
+def verify_signature(ctx: AnteContext) -> None:
+    if ctx.simulate:
+        return
+    tx = ctx.tx
+    signer = tx.signer_address()
+    for m in tx.msgs:
+        for s in m.signers():
+            if s != signer:
+                raise AnteError("message signer does not match tx signer")
+    acc = ctx.accounts.get_or_create(signer)
+    if acc.pubkey and acc.pubkey != tx.pubkey:
+        raise AnteError("pubkey does not match account")
+    if tx.account_number != acc.account_number:
+        raise AnteError(
+            f"account number mismatch: expected {acc.account_number}, "
+            f"got {tx.account_number}"
+        )
+    if tx.sequence != acc.sequence:
+        # the client-recoverable nonce error (app/errors/nonce_mismatch.go)
+        raise AnteError(
+            f"account sequence mismatch, expected {acc.sequence}, got {tx.sequence}: "
+            f"incorrect account sequence"
+        )
+    if not tx.verify_signature(ctx.chain_id):
+        raise AnteError("signature verification failed")
+    if not acc.pubkey:
+        acc.pubkey = tx.pubkey
+        ctx.accounts.set(acc)
+
+
+def increment_sequence(ctx: AnteContext) -> None:
+    if ctx.simulate:
+        return
+    ctx.accounts.increment_sequence(ctx.tx.signer_address())
+
+
+def min_gas_pfb(ctx: AnteContext) -> None:
+    """MinGasPFBDecorator: the tx must provision at least the blob gas its
+    PFB will consume (x/blob/ante/ante.go:14-48)."""
+    from celestia_tpu.appconsts import DEFAULT_GAS_PER_BLOB_BYTE
+
+    gas_per_byte = ctx.params.get("blob", "GasPerBlobByte", DEFAULT_GAS_PER_BLOB_BYTE)
+    for m in ctx.tx.msgs:
+        if isinstance(m, MsgPayForBlobs):
+            needed = gas_to_consume(m.blob_sizes, gas_per_byte)
+            if ctx.tx.fee.gas_limit < needed:
+                raise AnteError(
+                    f"gas limit {ctx.tx.fee.gas_limit} below blob gas {needed}"
+                )
+
+
+def blob_share_limit(ctx: AnteContext) -> None:
+    """BlobShareDecorator: blobs must fit the max effective square
+    (x/blob/ante/blob_share_decorator.go:17-70)."""
+    from celestia_tpu.appconsts import DEFAULT_GOV_MAX_SQUARE_SIZE
+
+    gov_max = ctx.params.get("blob", "GovMaxSquareSize", DEFAULT_GOV_MAX_SQUARE_SIZE)
+    hard_max = square_size_upper_bound(ctx.app_version)
+    k = min(gov_max, hard_max)
+    max_shares = k * k
+    for m in ctx.tx.msgs:
+        if isinstance(m, MsgPayForBlobs):
+            total = sum(sparse_shares_needed(s) for s in m.blob_sizes)
+            if total > max_shares:
+                raise AnteError(
+                    f"blob(s) need {total} shares > square capacity {max_shares}"
+                )
+
+
+def gov_param_filter(ctx: AnteContext) -> None:
+    """GovProposalDecorator + x/paramfilter: hardfork-only params are
+    unchangeable by any governance path."""
+    from celestia_tpu.state.params import ParamBlockList
+
+    block_list = ParamBlockList()
+    for m in ctx.tx.msgs:
+        if isinstance(m, MsgParamChange):
+            block_list.validate_change(m.subspace, m.key)
+
+
+DEFAULT_ANTE_CHAIN: List[Callable[[AnteContext], None]] = [
+    msg_gatekeeper,
+    validate_basic,
+    consume_tx_size_gas,
+    check_and_deduct_fee,
+    verify_signature,
+    increment_sequence,
+    min_gas_pfb,
+    blob_share_limit,
+    gov_param_filter,
+]
+
+
+def run_ante(ctx: AnteContext, chain: Optional[List[Callable]] = None) -> GasMeter:
+    """Run the chain; AnteError on rejection.  Panics inside decorators are
+    wrapped (HandlePanicDecorator, app/ante/panic.go)."""
+    for decorator in chain or DEFAULT_ANTE_CHAIN:
+        try:
+            decorator(ctx)
+        except AnteError:
+            raise
+        except Exception as e:  # panic guard with tx context
+            raise AnteError(
+                f"panic in ante decorator {decorator.__name__}: {e!r}"
+            ) from e
+    return ctx.gas_meter

@@ -1,0 +1,497 @@
+"""The App: ABCI-shaped state machine around the TPU DA pipeline.
+
+Parity with /root/reference/app/: construction & keeper wiring (app.go:227-
+664), CheckTx (check_tx.go:16-54), PrepareProposal (prepare_proposal.go:23-
+96), ProcessProposal (process_proposal.go:24-157), FilterTxs
+(validate_txs.go:29-97), Begin/EndBlocker + upgrade consumption
+(app.go:670-708), InitChainer (app.go:711-726), MaxEffectiveSquareSize
+(square_size.go:9-23), and genesis export (export.go:18-45).
+
+The consensus engine above this surface is celestia_tpu/node (testnode-style
+single-process driver); the DA compute below it is the fused device pipeline
+(da/dah.py).  Every consensus-relevant computation here is integer/bytes
+arithmetic or the bit-exact device kernels.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from celestia_tpu.appconsts import (
+    DEFAULT_MIN_GAS_PRICE,
+    LATEST_VERSION,
+    SHARE_SIZE,
+    square_size_upper_bound,
+)
+from celestia_tpu.da import dah as dah_mod
+from celestia_tpu.da.blob import BlobTx, unmarshal_blob_tx
+from celestia_tpu.da.square import Square, build as build_square, construct as construct_square
+from celestia_tpu.state import app_versions
+from celestia_tpu.state.ante import AnteContext, AnteError, GasMeter, run_ante
+from celestia_tpu.state.auth import AccountKeeper
+from celestia_tpu.state.bank import BankKeeper, FEE_COLLECTOR
+from celestia_tpu.state.modules.blob import BlobKeeper, validate_blob_tx
+from celestia_tpu.state.modules.blobstream import BlobstreamKeeper
+from celestia_tpu.state.modules.mint import MintKeeper
+from celestia_tpu.state.modules.upgrade import UpgradeKeeper
+from celestia_tpu.state.params import ParamBlockList, ParamsKeeper, set_default_params
+from celestia_tpu.state.staking import StakingKeeper
+from celestia_tpu.state.store import MultiStore
+from celestia_tpu.state.tx import (
+    Msg,
+    MsgDelegate,
+    MsgParamChange,
+    MsgPayForBlobs,
+    MsgRegisterEVMAddress,
+    MsgSend,
+    MsgSignalVersion,
+    MsgTryUpgrade,
+    MsgUndelegate,
+    Tx,
+    unmarshal_tx,
+)
+from celestia_tpu.utils.telemetry import Telemetry
+
+STORE_NAMES = [
+    "auth", "bank", "staking", "params", "blob", "upgrade", "blobstream", "mint", "meta",
+]
+
+_APP_VERSION_KEY = b"app_version"
+
+
+@dataclass
+class TxResult:
+    code: int  # 0 = ok
+    log: str
+    gas_wanted: int
+    gas_used: int
+    events: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class PreparedProposal:
+    block_txs: List[bytes]
+    square_size: int
+    data_root: bytes
+    eds: "dah_mod.ExtendedDataSquare"
+    dah: "dah_mod.DataAvailabilityHeader"
+
+
+class App:
+    """The celestia-tpu application (app.go App struct parity)."""
+
+    def __init__(
+        self,
+        chain_id: str = "celestia-tpu-1",
+        min_gas_price: float = DEFAULT_MIN_GAS_PRICE,
+        v2_upgrade_height: Optional[int] = None,
+    ):
+        self.chain_id = chain_id
+        self.min_gas_price = min_gas_price  # node-local CheckTx filter
+        self.v2_upgrade_height = v2_upgrade_height  # v1 height-based path
+        self.store = MultiStore(STORE_NAMES)
+        self._wire_keepers()
+        self.telemetry = Telemetry()
+        self.block_time_ns = 0
+        self.genesis_time_ns = 0
+        # persistent CheckTx state, branched from committed state and reset
+        # on every commit (baseapp checkState parity) — lets several pending
+        # txs from one account chain their sequences in the mempool
+        self._check_state: Optional[MultiStore] = None
+
+    def _wire_keepers(self) -> None:
+        self.accounts = AccountKeeper(self.store.store("auth"))
+        self.bank = BankKeeper(self.store.store("bank"))
+        self.params = ParamsKeeper(self.store.store("params"))
+        self.staking = StakingKeeper(self.store.store("staking"), self.bank)
+        self.blob = BlobKeeper(self.params)
+        self.upgrade = UpgradeKeeper(self.store.store("upgrade"), self.staking)
+        self.blobstream = BlobstreamKeeper(
+            self.store.store("blobstream"), self.staking, self.params
+        )
+        self.mint = MintKeeper(self.store.store("mint"), self.bank)
+        self.param_block_list = ParamBlockList()
+
+    # ------------------------------------------------------------------
+    # version / sizing
+    # ------------------------------------------------------------------
+
+    @property
+    def app_version(self) -> int:
+        raw = self.store.store("meta").get(_APP_VERSION_KEY)
+        return int.from_bytes(raw, "big") if raw else LATEST_VERSION
+
+    def _set_app_version(self, v: int) -> None:
+        self.store.store("meta").set(_APP_VERSION_KEY, v.to_bytes(8, "big"))
+
+    def max_effective_square_size(self) -> int:
+        """min(gov cap, hard cap) — square_size.go:9-23."""
+        gov = self.blob.gov_max_square_size()
+        return min(gov, square_size_upper_bound(self.app_version))
+
+    # ------------------------------------------------------------------
+    # genesis
+    # ------------------------------------------------------------------
+
+    def init_chain(self, genesis: dict) -> None:
+        """InitChainer parity: seed params, accounts, validators, mint state.
+
+        genesis = {
+          "chain_id", "app_version", "genesis_time_ns",
+          "accounts": [{"address": hex, "balance": int}],
+          "validators": [{"address": hex, "self_delegation": int}],
+          "params": {subspace: {key: value}},
+        }
+        """
+        self.chain_id = genesis.get("chain_id", self.chain_id)
+        set_default_params(self.params)
+        for subspace, kvs in genesis.get("params", {}).items():
+            for k, v in kvs.items():
+                self.params.set(subspace, k, v)
+        self._set_app_version(genesis.get("app_version", LATEST_VERSION))
+        self.genesis_time_ns = genesis.get(
+            "genesis_time_ns", _time.time_ns()
+        )
+        self.mint.init_genesis(self.genesis_time_ns)
+        for acc in genesis.get("accounts", []):
+            addr = bytes.fromhex(acc["address"])
+            self.bank.mint(addr, acc["balance"])
+            self.accounts.get_or_create(addr)
+        for val in genesis.get("validators", []):
+            addr = bytes.fromhex(val["address"])
+            self.accounts.get_or_create(addr)
+            shortfall = val["self_delegation"] - self.bank.balance(addr)
+            if shortfall > 0:
+                self.bank.mint(addr, shortfall)
+            self.staking.create_validator(addr, val["self_delegation"])
+        self.store.commit(1)  # genesis state at height 1
+
+    # ------------------------------------------------------------------
+    # CheckTx (mempool admission) — check_tx.go:16-54
+    # ------------------------------------------------------------------
+
+    def _get_check_state(self) -> MultiStore:
+        if self._check_state is None:
+            self._check_state = self.store.branch()
+        return self._check_state
+
+    def check_tx(self, raw: bytes, is_recheck: bool = False) -> TxResult:
+        self.telemetry.incr("check_tx")
+        btx = unmarshal_blob_tx(raw)
+        # run the ante chain on a branch of the persistent check state;
+        # only successful checks fold back (failed antes must not burn a
+        # pending account's sequence/fee in the check state)
+        check_state = self._get_check_state()
+        branch = check_state.branch()
+        try:
+            if btx is not None:
+                # reject BlobTx whose PFB is malformed; validate blobs fully
+                # on first check only (not recheck)
+                if is_recheck:
+                    tx = unmarshal_tx(btx.tx)
+                else:
+                    tx = validate_blob_tx(btx, self.chain_id)
+                raw_inner = btx.tx
+            else:
+                tx = unmarshal_tx(raw)
+                if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs):
+                    # PFB without blobs is never admissible (check_tx.go:30)
+                    return TxResult(1, "MsgPayForBlobs transaction missing blobs", 0, 0)
+                raw_inner = raw
+            ctx = AnteContext(
+                tx=tx,
+                raw_tx=raw_inner,
+                accounts=AccountKeeper(branch.store("auth")),
+                bank=BankKeeper(branch.store("bank")),
+                params=ParamsKeeper(branch.store("params")),
+                chain_id=self.chain_id,
+                app_version=self.app_version,
+                is_check_tx=True,
+                is_recheck=is_recheck,
+                min_gas_price=self.min_gas_price,
+            )
+            meter = run_ante(ctx)
+            check_state.write_back(branch)
+            return TxResult(0, "", tx.fee.gas_limit, meter.consumed)
+        except (AnteError, ValueError) as e:
+            self.telemetry.incr("check_tx_rejected")
+            return TxResult(1, str(e), 0, 0)
+
+    # ------------------------------------------------------------------
+    # PrepareProposal — prepare_proposal.go:23-96
+    # ------------------------------------------------------------------
+
+    def _filter_txs(self, txs: List[bytes]) -> List[bytes]:
+        """FilterTxs parity (validate_txs.go:29-97): run the ante chain over
+        each tx on one branched state, in priority order; drop failures."""
+        branch = self.store.branch()
+        accounts = AccountKeeper(branch.store("auth"))
+        bank = BankKeeper(branch.store("bank"))
+        params = ParamsKeeper(branch.store("params"))
+        kept: List[bytes] = []
+        for raw in txs:
+            btx = unmarshal_blob_tx(raw)
+            try:
+                if btx is not None:
+                    tx = validate_blob_tx(btx, self.chain_id)
+                    raw_inner = btx.tx
+                else:
+                    tx = unmarshal_tx(raw)
+                    if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs):
+                        raise AnteError("PFB without blobs")
+                    raw_inner = raw
+                ctx = AnteContext(
+                    tx=tx,
+                    raw_tx=raw_inner,
+                    accounts=accounts,
+                    bank=bank,
+                    params=params,
+                    chain_id=self.chain_id,
+                    app_version=self.app_version,
+                )
+                run_ante(ctx)
+                kept.append(raw)
+            except (AnteError, ValueError):
+                self.telemetry.incr("prepare_proposal_dropped_tx")
+                continue
+        return kept
+
+    def prepare_proposal(self, txs: List[bytes]) -> PreparedProposal:
+        t0 = _time.time()
+        try:
+            kept = self._filter_txs(txs)
+            square, block_txs, _wrappers = build_square(
+                kept, self.max_effective_square_size()
+            )
+            eds, dah = dah_mod.extend_block(square)
+            return PreparedProposal(
+                block_txs=block_txs,
+                square_size=square.size,
+                data_root=dah.hash,
+                eds=eds,
+                dah=dah,
+            )
+        finally:
+            self.telemetry.measure_since("prepare_proposal", t0)
+
+    # ------------------------------------------------------------------
+    # ProcessProposal — process_proposal.go:24-157
+    # ------------------------------------------------------------------
+
+    def process_proposal(
+        self, block_txs: List[bytes], square_size: int, data_root: bytes
+    ) -> Tuple[bool, str]:
+        """Returns (accept, reason).  Panics are caught -> REJECT
+        (process_proposal.go:26-34)."""
+        t0 = _time.time()
+        try:
+            branch = self.store.branch()
+            accounts = AccountKeeper(branch.store("auth"))
+            bank = BankKeeper(branch.store("bank"))
+            params = ParamsKeeper(branch.store("params"))
+            for raw in block_txs:
+                btx = unmarshal_blob_tx(raw)
+                if btx is not None:
+                    # full BlobTx re-validation incl. commitment recompute
+                    tx = validate_blob_tx(btx, self.chain_id)
+                    raw_inner = btx.tx
+                else:
+                    tx = unmarshal_tx(raw)
+                    if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs):
+                        return False, "PFB without blobs in proposal"
+                    raw_inner = raw
+                ctx = AnteContext(
+                    tx=tx,
+                    raw_tx=raw_inner,
+                    accounts=accounts,
+                    bank=bank,
+                    params=params,
+                    chain_id=self.chain_id,
+                    app_version=self.app_version,
+                )
+                run_ante(ctx)
+            # strict reconstruction
+            square, re_txs, _ = construct_square(
+                block_txs, self.max_effective_square_size()
+            )
+            if square.size != square_size:
+                return False, (
+                    f"square size mismatch: computed {square.size}, "
+                    f"header says {square_size}"
+                )
+            _, dah = dah_mod.extend_block(square)
+            if dah.hash != data_root:
+                self.telemetry.incr("process_proposal_rejected_data_root")
+                return False, (
+                    f"data root mismatch: computed {dah.hash.hex()}, "
+                    f"header says {data_root.hex()}"
+                )
+            return True, ""
+        except Exception as e:
+            self.telemetry.incr("process_proposal_panic_reject")
+            return False, f"proposal rejected: {e}"
+        finally:
+            self.telemetry.measure_since("process_proposal", t0)
+
+    # ------------------------------------------------------------------
+    # Block execution (Begin/Deliver/End/Commit)
+    # ------------------------------------------------------------------
+
+    def begin_block(self, height: int, time_ns: int) -> None:
+        self.block_time_ns = time_ns
+        self.mint.begin_blocker(time_ns)
+
+    def deliver_tx(self, raw: bytes) -> TxResult:
+        """Execute one block tx (blob txs execute their inner PFB only —
+        blobs never touch state; keeper.go:42-57)."""
+        btx = unmarshal_blob_tx(raw)
+        if btx is not None:
+            tx = unmarshal_tx(btx.tx)
+            raw_inner = btx.tx
+        else:
+            tx = unmarshal_tx(raw)
+            raw_inner = raw
+        # Phase 1 (SDK runTx parity): the ante chain runs on its own branch;
+        # on success its writes (fee deduction, sequence bump) persist even
+        # if message execution later fails.
+        ante_branch = self.store.branch()
+        ctx = AnteContext(
+            tx=tx,
+            raw_tx=raw_inner,
+            accounts=AccountKeeper(ante_branch.store("auth")),
+            bank=BankKeeper(ante_branch.store("bank")),
+            params=ParamsKeeper(ante_branch.store("params")),
+            chain_id=self.chain_id,
+            app_version=self.app_version,
+        )
+        try:
+            meter = run_ante(ctx)
+        except AnteError as e:
+            return TxResult(1, str(e), tx.fee.gas_limit, 0)
+        self.store.write_back(ante_branch)
+        # Phase 2: messages execute on a cache-wrap; a failure discards ALL
+        # message writes (atomic tx execution) while keeping the ante's.
+        msg_branch = self.store.branch()
+        saved_store = self.store
+        self.store = msg_branch
+        self._wire_keepers()
+        events: List[dict] = []
+        try:
+            for m in tx.msgs:
+                events.append(self._execute_msg(m, meter))
+        except Exception as e:
+            return TxResult(
+                2, f"msg execution failed: {e}", tx.fee.gas_limit, meter.consumed
+            )
+        else:
+            saved_store.write_back(msg_branch)
+            return TxResult(0, "", tx.fee.gas_limit, meter.consumed, events)
+        finally:
+            self.store = saved_store
+            self._wire_keepers()
+
+    def _execute_msg(self, msg: Msg, gas_meter: GasMeter) -> dict:
+        if isinstance(msg, MsgSend):
+            self.bank.send(msg.from_addr, msg.to_addr, msg.amount)
+            return {"type": "transfer", "amount": msg.amount}
+        if isinstance(msg, MsgPayForBlobs):
+            return self.blob.pay_for_blobs(msg, gas_meter)
+        if isinstance(msg, MsgDelegate):
+            self.staking.delegate(msg.delegator, msg.validator, msg.amount)
+            return {"type": "delegate", "amount": msg.amount}
+        if isinstance(msg, MsgUndelegate):
+            self.staking.undelegate(msg.delegator, msg.validator, msg.amount)
+            return {"type": "undelegate", "amount": msg.amount}
+        if isinstance(msg, MsgSignalVersion):
+            self.upgrade.signal_version(msg.validator, msg.version, self.app_version)
+            return {"type": "signal_version", "version": msg.version}
+        if isinstance(msg, MsgTryUpgrade):
+            scheduled = self.upgrade.try_upgrade(self.app_version)
+            return {"type": "try_upgrade", "scheduled": scheduled}
+        if isinstance(msg, MsgRegisterEVMAddress):
+            self.blobstream.register_evm_address(msg.validator, msg.evm_address)
+            return {"type": "register_evm_address"}
+        if isinstance(msg, MsgParamChange):
+            self.param_block_list.validate_change(msg.subspace, msg.key)
+            import json as _json
+
+            self.params.set(msg.subspace, msg.key, _json.loads(msg.value))
+            return {"type": "param_change", "key": f"{msg.subspace}/{msg.key}"}
+        raise ValueError(f"no handler for message {type(msg).__name__}")
+
+    def end_block(self, height: int, time_ns: int) -> dict:
+        """EndBlocker parity (app.go:675-708): module end-blockers, then
+        upgrade consumption (v1 height-based or v2 signal-based)."""
+        attestations = self.blobstream.end_blocker(height, time_ns)
+        upgraded_to = None
+        if self.app_version == 1 and self.v2_upgrade_height is not None:
+            if height == self.v2_upgrade_height - 1:
+                upgraded_to = 2
+        else:
+            pending = self.upgrade.should_upgrade()
+            if pending is not None and pending > self.app_version:
+                if pending in app_versions.supported_versions():
+                    upgraded_to = pending
+                else:
+                    # quorum reached but this binary can't run the new
+                    # version: keep the upgrade pending (operators must
+                    # restart with the release that supports it)
+                    self.telemetry.incr("upgrade_pending_unsupported")
+        if upgraded_to is not None:
+            log = app_versions.run_migrations(self, self.app_version, upgraded_to)
+            self._set_app_version(upgraded_to)
+            self.upgrade.consume_upgrade()
+            self.telemetry.incr("upgrades")
+            return {"attestations": attestations, "upgraded_to": upgraded_to, "migrations": log}
+        return {"attestations": attestations}
+
+    def finalize_block(
+        self,
+        block_txs: List[bytes],
+        height: int,
+        time_ns: int,
+        data_root: bytes,
+    ) -> Tuple[List[TxResult], dict, bytes]:
+        """Begin -> deliver all -> end -> record data root -> commit.
+
+        Returns (tx results, end-block response, app hash)."""
+        self.begin_block(height, time_ns)
+        results = [self.deliver_tx(raw) for raw in block_txs]
+        self.blobstream.record_data_root(height, data_root)
+        end = self.end_block(height, time_ns)
+        app_hash = self.store.commit(height)
+        # reset the CheckTx state to the fresh committed state (baseapp
+        # resets checkState on Commit; pending mempool txs get recheck'd)
+        self._check_state = None
+        return results, end, app_hash
+
+    # ------------------------------------------------------------------
+    # export / load (checkpoint-resume surface)
+    # ------------------------------------------------------------------
+
+    def export_genesis(self) -> dict:
+        """ExportAppStateAndValidators parity (export.go:18-45)."""
+        return {
+            "chain_id": self.chain_id,
+            "app_version": self.app_version,
+            "genesis_time_ns": self.genesis_time_ns,
+            "state": self.store.export(),
+        }
+
+    @classmethod
+    def import_genesis(cls, dump: dict, **kwargs) -> "App":
+        app = cls(chain_id=dump["chain_id"], **kwargs)
+        app.store = MultiStore.import_state(dump["state"])
+        for name in STORE_NAMES:
+            app.store.ensure_store(name)
+        app._wire_keepers()
+        app.genesis_time_ns = dump.get("genesis_time_ns", 0)
+        app.store.commit(1)
+        return app
+
+    def load_height(self, height: int) -> None:
+        """Roll back to a committed height (app.go:729 LoadHeight)."""
+        self.store.load_height(height)
+        self._wire_keepers()

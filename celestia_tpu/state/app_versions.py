@@ -1,0 +1,90 @@
+"""Multi-versioned state machine registry (ADR-022 parity).
+
+The reference registers modules with [FromVersion, ToVersion] ranges and a
+versioned configurator records which messages each app version accepts
+(app/module/module.go:20-100, configurator.go:34-76); the ante
+MsgVersioningGateKeeper consults it.  Here: per-version accepted message
+sets + migration callbacks run on upgrade (module.go:231 RunMigrations).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Set, Type
+
+from celestia_tpu.appconsts import V1_VERSION, V2_VERSION
+from celestia_tpu.state.tx import (
+    MsgDelegate,
+    MsgParamChange,
+    MsgPayForBlobs,
+    MsgRegisterEVMAddress,
+    MsgSend,
+    MsgSignalVersion,
+    MsgTryUpgrade,
+    MsgUndelegate,
+)
+
+_V1_MSGS: Set[type] = {
+    MsgSend,
+    MsgPayForBlobs,
+    MsgDelegate,
+    MsgUndelegate,
+    MsgRegisterEVMAddress,
+    MsgParamChange,
+}
+
+# v2 adds the x/upgrade signalling msgs (and the x/minfee param subspace)
+_V2_MSGS: Set[type] = _V1_MSGS | {MsgSignalVersion, MsgTryUpgrade}
+
+_ACCEPTED: Dict[int, Set[type]] = {
+    V1_VERSION: _V1_MSGS,
+    V2_VERSION: _V2_MSGS,
+}
+
+
+def msgs_accepted_at(app_version: int) -> Set[type]:
+    try:
+        return _ACCEPTED[app_version]
+    except KeyError:
+        raise ValueError(f"unsupported app version {app_version}") from None
+
+
+def supported_versions() -> List[int]:
+    return sorted(_ACCEPTED)
+
+
+def register_version(version: int, msgs: Set[type]) -> None:
+    """Register a new app version's accepted-message set (what a future
+    binary release does; module.go version-range registration parity)."""
+    _ACCEPTED[version] = set(msgs)
+
+
+# --- migrations -------------------------------------------------------------
+
+# target_version -> list of callables(app) run when upgrading TO that version
+_MIGRATIONS: Dict[int, List[Callable]] = {}
+
+
+def register_migration(target_version: int, fn: Callable) -> None:
+    _MIGRATIONS.setdefault(target_version, []).append(fn)
+
+
+def run_migrations(app, from_version: int, to_version: int) -> List[str]:
+    """RunMigrations parity: apply every registered migration between
+    versions in order; returns a log."""
+    log = []
+    for v in range(from_version + 1, to_version + 1):
+        for fn in _MIGRATIONS.get(v, []):
+            fn(app)
+            log.append(f"migration {fn.__name__} -> v{v}")
+    return log
+
+
+def _migrate_v2_minfee(app) -> None:
+    """v1 -> v2: introduce the x/minfee network min gas price param."""
+    from celestia_tpu.appconsts import GLOBAL_MIN_GAS_PRICE
+
+    if not app.params.has("minfee", "NetworkMinGasPrice"):
+        app.params.set("minfee", "NetworkMinGasPrice", GLOBAL_MIN_GAS_PRICE)
+
+
+register_migration(V2_VERSION, _migrate_v2_minfee)

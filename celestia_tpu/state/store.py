@@ -1,68 +1,129 @@
-"""Versioned key-value multistore with Merkle app hash.
+"""Versioned key-value multistore with Merkle app hash and copy-on-write
+branches.
 
 Role parity with the reference's IAVL/LevelDB commit-multistore (SURVEY.md
 §2.1 "framework": baseapp stores): namespaced substores per module, branch/
 cache-wrap semantics for speculative execution (CheckTx / proposal
-processing), commit-per-height versioning with app-hash, load-at-height
-rollback, and full export/import for genesis and state-sync-style snapshots.
+processing / per-tx delivery), commit-per-height versioning with app-hash,
+load-at-height rollback, and full export/import for genesis and state-sync
+-style snapshots.
 
-Implementation is an in-memory copy-on-write dict (this framework's node is
-a library/devnet runtime, not a disk daemon yet); the app hash is a
-deterministic SHA-256 over sorted (store, key, value) triples so every
-validator computes the identical hash for identical state.
+Branches are overlay stores (write layer + read-through to the parent), so
+branching is O(1) and a branch costs O(its own writes) — the cache-wrap
+semantics of the SDK's CacheMultiStore.  The app hash is a deterministic
+SHA-256 over sorted (store, key, value) triples so every validator computes
+the identical hash for identical state.
 """
 
 from __future__ import annotations
 
 import hashlib
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+
+class _DictLayer:
+    """Base storage layer backed by a plain dict."""
+
+    def __init__(self, data: Optional[Dict[bytes, bytes]] = None):
+        self.data: Dict[bytes, bytes] = data if data is not None else {}
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self.data.get(key)
+
+    def has(self, key: bytes) -> bool:
+        return key in self.data
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self.data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        self.data.pop(key, None)
+
+    def keys(self) -> Set[bytes]:
+        return set(self.data)
+
+
+class _OverlayLayer:
+    """Copy-on-write layer: local writes/deletes over a parent layer."""
+
+    def __init__(self, parent):
+        self.parent = parent
+        self.writes: Dict[bytes, bytes] = {}
+        self.deletes: Set[bytes] = set()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        if key in self.writes:
+            return self.writes[key]
+        if key in self.deletes:
+            return None
+        return self.parent.get(key)
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self.writes[key] = value
+        self.deletes.discard(key)
+
+    def delete(self, key: bytes) -> None:
+        self.writes.pop(key, None)
+        self.deletes.add(key)
+
+    def keys(self) -> Set[bytes]:
+        return (self.parent.keys() - self.deletes) | set(self.writes)
+
+    def apply_to_parent(self) -> None:
+        for k, v in self.writes.items():
+            self.parent.set(k, v)
+        for k in self.deletes:
+            self.parent.delete(k)
+        self.writes.clear()
+        self.deletes.clear()
 
 
 class KVStore:
-    """A single namespaced store view backed by a dict."""
+    """A single namespaced store view."""
 
-    def __init__(self, data: Dict[bytes, bytes]):
-        self._data = data
+    def __init__(self, layer):
+        self._layer = layer
 
     def get(self, key: bytes) -> Optional[bytes]:
-        return self._data.get(key)
+        return self._layer.get(key)
 
     def set(self, key: bytes, value: bytes) -> None:
         if not isinstance(key, bytes) or not isinstance(value, bytes):
             raise TypeError("keys and values must be bytes")
-        self._data[key] = value
+        self._layer.set(key, value)
 
     def delete(self, key: bytes) -> None:
-        self._data.pop(key, None)
+        self._layer.delete(key)
 
     def has(self, key: bytes) -> bool:
-        return key in self._data
+        return self._layer.has(key)
 
     def iterate(self, prefix: bytes = b"") -> Iterator[Tuple[bytes, bytes]]:
         """Deterministic (sorted) iteration over keys with the prefix."""
-        for k in sorted(self._data):
+        for k in sorted(self._layer.keys()):
             if k.startswith(prefix):
-                yield k, self._data[k]
+                v = self._layer.get(k)
+                if v is not None:
+                    yield k, v
 
 
 class MultiStore:
-    """Named substores + commit versioning.
-
-    ``branch()`` returns a deep-copied speculative store (the SDK's
-    CacheMultiStore used by CheckTx and proposal handling); ``commit()``
-    seals a version and returns the app hash.
-    """
+    """Named substores + commit versioning + O(1) overlay branching."""
 
     def __init__(self, store_names: List[str]):
         self._names = list(store_names)
-        self._stores: Dict[str, Dict[bytes, bytes]] = {n: {} for n in store_names}
+        self._layers: Dict[str, object] = {n: _DictLayer() for n in store_names}
         self._versions: List[Tuple[int, Dict[str, Dict[bytes, bytes]], bytes]] = []
         self._last_height = 0
+        self._parent: Optional["MultiStore"] = None
 
     def store(self, name: str) -> KVStore:
-        if name not in self._stores:
+        if name not in self._layers:
             raise KeyError(f"unknown store {name!r}")
-        return KVStore(self._stores[name])
+        return KVStore(self._layers[name])
 
     @property
     def store_names(self) -> List[str]:
@@ -70,39 +131,52 @@ class MultiStore:
 
     def ensure_store(self, name: str) -> None:
         """Mount a new substore (upgrade-time store additions)."""
-        if name not in self._stores:
+        if name not in self._layers:
             self._names.append(name)
-            self._stores[name] = {}
+            self._layers[name] = _DictLayer()
 
-    # --- branching --------------------------------------------------------
+    # --- branching (CacheMultiStore semantics) ----------------------------
 
     def branch(self) -> "MultiStore":
-        ms = MultiStore(self._names)
-        ms._stores = {n: dict(d) for n, d in self._stores.items()}
+        ms = MultiStore.__new__(MultiStore)
+        ms._names = list(self._names)
+        ms._layers = {n: _OverlayLayer(layer) for n, layer in self._layers.items()}
+        ms._versions = []
         ms._last_height = self._last_height
+        ms._parent = self
         return ms
 
     def write_back(self, branched: "MultiStore") -> None:
-        """Apply a branched store's state over this one (ante success path)."""
-        self._stores = {n: dict(d) for n, d in branched._stores.items()}
+        """Apply a branch's writes to this store (the branch must have been
+        created from this store)."""
+        if branched._parent is not self:
+            raise ValueError("write_back: branch does not belong to this store")
+        for layer in branched._layers.values():
+            layer.apply_to_parent()
 
     # --- commit / versions ------------------------------------------------
 
+    def _flatten(self, name: str) -> Dict[bytes, bytes]:
+        layer = self._layers[name]
+        return {k: layer.get(k) for k in layer.keys()}
+
     def app_hash(self) -> bytes:
         h = hashlib.sha256()
-        for name in sorted(self._stores):
-            data = self._stores[name]
+        for name in sorted(self._layers):
+            data = self._flatten(name)
             for k in sorted(data):
                 h.update(hashlib.sha256(name.encode() + b"\x00" + k).digest())
                 h.update(hashlib.sha256(data[k]).digest())
         return h.digest()
 
     def commit(self, height: int) -> bytes:
+        if self._parent is not None:
+            raise ValueError("cannot commit a branched store")
         if height <= self._last_height:
             raise ValueError(
                 f"commit height {height} must be > last committed {self._last_height}"
             )
-        snapshot = {n: dict(d) for n, d in self._stores.items()}
+        snapshot = {n: dict(self._flatten(n)) for n in self._layers}
         ah = self.app_hash()
         self._versions.append((height, snapshot, ah))
         self._last_height = height
@@ -121,9 +195,9 @@ class MultiStore:
         (app.LoadHeight parity, app/app.go:729)."""
         for h, snap, _ in self._versions:
             if h == height:
-                self._stores = {n: dict(d) for n, d in snap.items()}
+                self._layers = {n: _DictLayer(dict(d)) for n, d in snap.items()}
+                self._names = sorted(snap)
                 self._last_height = h
-                # drop newer versions
                 self._versions = [v for v in self._versions if v[0] <= height]
                 return
         raise KeyError(f"no committed version at height {height}")
@@ -139,13 +213,15 @@ class MultiStore:
     def export(self) -> Dict[str, Dict[str, str]]:
         """JSON-able dump of all stores (hex keys/values)."""
         return {
-            n: {k.hex(): v.hex() for k, v in sorted(d.items())}
-            for n, d in self._stores.items()
+            n: {k.hex(): v.hex() for k, v in sorted(self._flatten(n).items())}
+            for n in self._layers
         }
 
     @classmethod
     def import_state(cls, dump: Dict[str, Dict[str, str]]) -> "MultiStore":
         ms = cls(sorted(dump))
         for n, d in dump.items():
-            ms._stores[n] = {bytes.fromhex(k): bytes.fromhex(v) for k, v in d.items()}
+            ms._layers[n] = _DictLayer(
+                {bytes.fromhex(k): bytes.fromhex(v) for k, v in d.items()}
+            )
         return ms

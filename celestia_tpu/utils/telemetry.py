@@ -1,0 +1,40 @@
+"""Telemetry: counters + latency histograms around the hot path.
+
+Parity role: cosmos-sdk telemetry as used by the reference
+(telemetry.MeasureSince in Prepare/Process at app/prepare_proposal.go:24 and
+app/process_proposal.go:25, invalid-tx counters validate_txs.go:58,88,
+panic counter process_proposal.go:31, mint gauges x/mint/abci.go:15,72).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict, List
+
+
+class Telemetry:
+    def __init__(self):
+        self.counters: Dict[str, int] = defaultdict(int)
+        self.gauges: Dict[str, float] = {}
+        self.timings: Dict[str, List[float]] = defaultdict(list)
+
+    def incr(self, name: str, by: int = 1) -> None:
+        self.counters[name] += by
+
+    def gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = value
+
+    def measure_since(self, name: str, t0: float) -> None:
+        self.timings[name].append(time.time() - t0)
+
+    def summary(self) -> dict:
+        out: dict = {"counters": dict(self.counters), "gauges": dict(self.gauges)}
+        for name, vals in self.timings.items():
+            s = sorted(vals)
+            out[name] = {
+                "count": len(s),
+                "p50_ms": s[len(s) // 2] * 1000 if s else 0.0,
+                "max_ms": s[-1] * 1000 if s else 0.0,
+            }
+        return out

@@ -1,0 +1,331 @@
+"""State-machine tests: stores, tx codec, ante chain, modules.
+
+Mirrors the reference's unit tier for app/ante (SURVEY.md §4 tier 1) and the
+deterministic ABCI-driving tier (tier 3, test/util/test_app.go shape).
+"""
+
+import numpy as np
+import pytest
+
+from celestia_tpu.state import app_versions
+from celestia_tpu.state.ante import AnteContext, AnteError, GasMeter, run_ante
+from celestia_tpu.state.app import App
+from celestia_tpu.state.auth import AccountKeeper
+from celestia_tpu.state.bank import BankKeeper, FEE_COLLECTOR
+from celestia_tpu.state.modules.mint import (
+    NANOSECONDS_PER_YEAR,
+    inflation_rate_ppm,
+)
+from celestia_tpu.state.modules.tokenfilter import (
+    Acknowledgement,
+    FungibleTokenPacketData,
+    Packet,
+    on_recv_packet,
+)
+from celestia_tpu.state.params import ParamBlockList
+from celestia_tpu.state.store import MultiStore
+from celestia_tpu.state.tx import (
+    Fee,
+    MsgPayForBlobs,
+    MsgSend,
+    MsgSignalVersion,
+    Tx,
+    unmarshal_tx,
+)
+from celestia_tpu.utils.secp256k1 import PrivateKey, PublicKey
+
+
+# --- crypto -----------------------------------------------------------------
+
+
+def test_secp256k1_sign_verify():
+    key = PrivateKey.from_seed(b"alice")
+    pub = key.public_key()
+    sig = key.sign(b"message")
+    assert len(sig) == 64
+    assert pub.verify(b"message", sig)
+    assert not pub.verify(b"other message", sig)
+    # deterministic (RFC 6979)
+    assert key.sign(b"message") == sig
+    # pubkey roundtrip
+    assert PublicKey.from_compressed(pub.compressed()) == pub
+
+
+# --- store ------------------------------------------------------------------
+
+
+def test_multistore_commit_and_rollback():
+    ms = MultiStore(["a", "b"])
+    ms.store("a").set(b"k", b"v1")
+    h1 = ms.commit(1)
+    ms.store("a").set(b"k", b"v2")
+    ms.store("b").set(b"x", b"y")
+    h2 = ms.commit(2)
+    assert h1 != h2
+    ms.load_height(1)
+    assert ms.store("a").get(b"k") == b"v1"
+    assert ms.store("b").get(b"x") is None
+    # identical state -> identical hash (validator determinism)
+    ms2 = MultiStore(["a", "b"])
+    ms2.store("a").set(b"k", b"v1")
+    assert ms2.commit(1) == h1
+
+
+def test_multistore_branch_isolation():
+    ms = MultiStore(["a"])
+    ms.store("a").set(b"k", b"v")
+    br = ms.branch()
+    br.store("a").set(b"k", b"changed")
+    assert ms.store("a").get(b"k") == b"v"
+    ms.write_back(br)
+    assert ms.store("a").get(b"k") == b"changed"
+
+
+def test_multistore_export_import():
+    ms = MultiStore(["a"])
+    ms.store("a").set(b"bin\x00key", b"\xff\xfe")
+    dump = ms.export()
+    ms2 = MultiStore.import_state(dump)
+    assert ms2.store("a").get(b"bin\x00key") == b"\xff\xfe"
+    assert ms2.app_hash() == ms.app_hash()
+
+
+# --- tx codec ---------------------------------------------------------------
+
+
+def test_tx_roundtrip_and_signature():
+    key = PrivateKey.from_seed(b"bob")
+    msg = MsgSend(key.public_key().address(), b"\x01" * 20, 1000)
+    tx = Tx((msg,), Fee(500, 100_000), key.public_key().compressed(), 3, 7, "memo")
+    signed = tx.signed(key, "test-chain")
+    raw = signed.marshal()
+    back = unmarshal_tx(raw)
+    assert back == signed
+    assert back.verify_signature("test-chain")
+    assert not back.verify_signature("other-chain")  # chain id is signed
+    # tampering breaks the signature
+    tampered = Tx((MsgSend(msg.from_addr, msg.to_addr, 9999),), signed.fee,
+                  signed.pubkey, signed.sequence, signed.account_number,
+                  signed.memo, signed.signature)
+    assert not tampered.verify_signature("test-chain")
+
+
+# --- ante chain -------------------------------------------------------------
+
+
+def _make_ctx(tx, ms, chain_id="test-chain", **kw):
+    return AnteContext(
+        tx=tx,
+        raw_tx=tx.marshal(),
+        accounts=AccountKeeper(ms.store("auth")),
+        bank=BankKeeper(ms.store("bank")),
+        params=__import__("celestia_tpu.state.params", fromlist=["ParamsKeeper"]).ParamsKeeper(ms.store("params")),
+        app_version=2,
+        chain_id=chain_id,
+        **kw,
+    )
+
+
+def _funded_tx(ms, amount=10**9, fee=Fee(300, 100_000), seq=0):
+    key = PrivateKey.from_seed(b"carol")
+    addr = key.public_key().address()
+    bank = BankKeeper(ms.store("bank"))
+    bank.mint(addr, amount)
+    AccountKeeper(ms.store("auth")).get_or_create(addr)
+    msg = MsgSend(addr, b"\x02" * 20, 100)
+    tx = Tx((msg,), fee, key.public_key().compressed(), seq, 0)
+    return tx.signed(key, "test-chain"), key, addr
+
+
+def test_ante_accepts_valid_tx_and_deducts_fee():
+    ms = MultiStore(["auth", "bank", "params"])
+    tx, _, addr = _funded_tx(ms)
+    bank = BankKeeper(ms.store("bank"))
+    before = bank.balance(addr)
+    run_ante(_make_ctx(tx, ms))
+    assert bank.balance(addr) == before - tx.fee.amount
+    assert bank.balance(FEE_COLLECTOR) == tx.fee.amount
+    # sequence incremented
+    assert AccountKeeper(ms.store("auth")).get(addr).sequence == 1
+
+
+def test_ante_rejects_bad_signature_wrong_sequence_low_fee():
+    ms = MultiStore(["auth", "bank", "params"])
+    tx, key, addr = _funded_tx(ms)
+    # wrong chain id -> bad signature
+    with pytest.raises(AnteError, match="signature"):
+        run_ante(_make_ctx(tx, ms, chain_id="wrong-chain"))
+    # wrong sequence
+    bad_seq = Tx(tx.msgs, tx.fee, tx.pubkey, 5, 0).signed(key, "test-chain")
+    with pytest.raises(AnteError, match="sequence mismatch, expected 0, got 5"):
+        run_ante(_make_ctx(bad_seq, ms))
+    # fee below network min gas price (0.002 * 100k = 200utia)
+    cheap = Tx(tx.msgs, Fee(100, 100_000), tx.pubkey, 0, 0).signed(key, "test-chain")
+    with pytest.raises(AnteError, match="insufficient fee"):
+        run_ante(_make_ctx(cheap, ms))
+
+
+def test_ante_msg_gatekeeper_versions():
+    ms = MultiStore(["auth", "bank", "params"])
+    key = PrivateKey.from_seed(b"val")
+    addr = key.public_key().address()
+    BankKeeper(ms.store("bank")).mint(addr, 10**9)
+    msg = MsgSignalVersion(addr, 2)
+    tx = Tx((msg,), Fee(300, 100_000), key.public_key().compressed(), 0, 0).signed(
+        key, "test-chain"
+    )
+    ctx = _make_ctx(tx, ms)
+    ctx.app_version = 1  # MsgSignalVersion doesn't exist at v1
+    with pytest.raises(AnteError, match="not accepted at app version 1"):
+        run_ante(ctx)
+
+
+def test_gas_meter_out_of_gas():
+    m = GasMeter(100)
+    m.consume(90, "a")
+    with pytest.raises(AnteError, match="out of gas"):
+        m.consume(20, "b")
+
+
+# --- params / paramfilter ---------------------------------------------------
+
+
+def test_param_block_list():
+    pbl = ParamBlockList()
+    with pytest.raises(ValueError, match="hardfork"):
+        pbl.validate_change("staking", "BondDenom")
+    pbl.validate_change("blob", "GovMaxSquareSize")  # allowed
+
+
+# --- mint math --------------------------------------------------------------
+
+
+def test_inflation_schedule():
+    # 8% initial, -10%/yr, 1.5% floor (minter_test.go behaviors)
+    assert inflation_rate_ppm(0) == 80_000
+    assert inflation_rate_ppm(1) == 72_000
+    assert inflation_rate_ppm(2) == 64_800
+    for y in range(30):
+        assert inflation_rate_ppm(y) >= 15_000
+    assert inflation_rate_ppm(20) == 15_000  # hit the floor
+
+
+def test_mint_begin_blocker_provision():
+    app = App()
+    app.init_chain({"accounts": [{"address": "11" * 20, "balance": 10**12}]})
+    supply0 = app.bank.supply()
+    fee0 = app.bank.balance(FEE_COLLECTOR)
+    t0 = app.genesis_time_ns
+    app.mint.begin_blocker(t0 + 15 * 10**9)  # one 15s block later
+    minted = app.bank.balance(FEE_COLLECTOR) - fee0
+    # expected: supply * 8% * (15s/year)
+    expected = supply0 * 80_000 // 1_000_000 * (15 * 10**9) // NANOSECONDS_PER_YEAR
+    assert abs(minted - expected) <= expected // 100 + 1
+    assert app.bank.supply() == supply0 + minted
+
+
+# --- tokenfilter ------------------------------------------------------------
+
+
+def test_tokenfilter_accepts_returning_native():
+    data = FungibleTokenPacketData("transfer/channel-0/utia", "100", "a", "b")
+    pkt = Packet("transfer", "channel-0", "transfer", "channel-1", data.to_json())
+    assert on_recv_packet(pkt).success
+
+
+def test_tokenfilter_rejects_foreign():
+    # foreign token arriving fresh (no returning prefix)
+    data = FungibleTokenPacketData("uatom", "100", "a", "b")
+    pkt = Packet("transfer", "channel-0", "transfer", "channel-1", data.to_json())
+    ack = on_recv_packet(pkt)
+    assert not ack.success and "not accepted" in ack.error
+    # garbage payload
+    ack2 = on_recv_packet(Packet("transfer", "channel-0", "t", "c", b"junk"))
+    assert not ack2.success
+
+
+# --- versioned module manager ----------------------------------------------
+
+
+def test_msgs_accepted_per_version():
+    v1 = app_versions.msgs_accepted_at(1)
+    v2 = app_versions.msgs_accepted_at(2)
+    assert MsgSignalVersion not in v1
+    assert MsgSignalVersion in v2
+    assert MsgSend in v1 and MsgSend in v2
+    with pytest.raises(ValueError):
+        app_versions.msgs_accepted_at(99)
+
+
+# --- review-driven regression tests ----------------------------------------
+
+
+def test_deliver_tx_failed_msg_rolls_back_state():
+    """A failing message must not leave partial writes (SDK runTx parity):
+    fees/sequence from ante persist, message writes are discarded atomically."""
+    from celestia_tpu.state.app import App
+    from celestia_tpu.state.tx import MsgSend, Fee, Tx
+
+    key = PrivateKey.from_seed(b"partial")
+    addr = key.public_key().address()
+    app = App()
+    app.init_chain({"accounts": [{"address": addr.hex(), "balance": 10_000}]})
+    app.begin_block(2, app.genesis_time_ns + 10**9)
+    fee = Fee(300, 100_000)
+    # msg1 would succeed; msg2 overdraws -> whole tx must roll back
+    msgs = (
+        MsgSend(addr, b"\x01" * 20, 100),
+        MsgSend(addr, b"\x02" * 20, 10**18),
+    )
+    tx = Tx(msgs, fee, key.public_key().compressed(), 0, 0).signed(
+        key, app.chain_id
+    )
+    res = app.deliver_tx(tx.marshal())
+    assert res.code == 2
+    # fee charged, sequence bumped (ante persisted)...
+    assert app.accounts.get(addr).sequence == 1
+    # ...but NO transfer leaked from msg1
+    assert app.bank.balance(b"\x01" * 20) == 0
+    assert app.bank.balance(addr) == 10_000 - fee.amount
+
+
+def test_check_state_allows_chained_sequences():
+    """Two pending txs from one account must both pass CheckTx before a
+    block is cut (persistent check-state, baseapp parity)."""
+    from celestia_tpu.state.app import App
+    from celestia_tpu.state.tx import MsgSend, Fee, Tx
+
+    key = PrivateKey.from_seed(b"pending")
+    addr = key.public_key().address()
+    app = App()
+    app.init_chain({"accounts": [{"address": addr.hex(), "balance": 10**9}]})
+
+    def send(seq):
+        return (
+            Tx((MsgSend(addr, b"\x03" * 20, 10),), Fee(300, 100_000),
+               key.public_key().compressed(), seq, 0)
+            .signed(key, app.chain_id)
+            .marshal()
+        )
+
+    assert app.check_tx(send(0)).code == 0
+    r2 = app.check_tx(send(1))
+    assert r2.code == 0, r2.log  # would fail without persistent check state
+    # a replay of seq 0 is now rejected in check
+    assert app.check_tx(send(0)).code != 0
+
+
+def test_genesis_validator_balance_topup_is_shortfall_only():
+    from celestia_tpu.state.app import App
+
+    app = App()
+    app.init_chain(
+        {
+            "accounts": [{"address": "ee" * 20, "balance": 60}],
+            "validators": [{"address": "ee" * 20, "self_delegation": 100}],
+        }
+    )
+    addr = bytes.fromhex("ee" * 20)
+    # exactly the shortfall was minted: balance is now 0 after delegating 100
+    assert app.bank.balance(addr) == 0
+    assert app.staking.validator(addr).tokens == 100

@@ -163,3 +163,39 @@ def rfc6962_root_np(leaves: list) -> np.ndarray:
         return hashlib.sha256(b"\x01" + left + right).digest()
 
     return np.frombuffer(rec([bytes(x) for x in leaves]), dtype=np.uint8)
+
+
+def nmt_level_stack(leaves: jnp.ndarray) -> list:
+    """All levels of the NMT: [leaf digests (n), level1 (n/2), ..., root (1)].
+
+    The level stack is what proof generation needs (inner nodes at every
+    aligned span) — the reference gets these via the NodeVisitor cache
+    (pkg/inclusion/nmt_caching.go:80-124); here they fall out of the
+    level-synchronous reduction for free.
+    """
+    n = leaves.shape[-2]
+    if n & (n - 1):
+        raise ValueError(f"leaf count must be a power of two, got {n}")
+    levels = [leaf_digests(leaves)]
+    while levels[-1].shape[-2] > 1:
+        levels.append(combine_level(levels[-1]))
+    return levels
+
+
+def combine_digests_np(left: bytes, right: bytes) -> bytes:
+    """Host-side NMT node combine (for proof verification)."""
+    import hashlib
+
+    l_min, l_max = left[:NAMESPACE_SIZE], left[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
+    r_min, r_max = right[:NAMESPACE_SIZE], right[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
+    max_ns = l_max if r_min == bytes(_PARITY_NS) else r_max
+    h = hashlib.sha256(b"\x01" + left + right).digest()
+    return l_min + max_ns + h
+
+
+def leaf_digest_np(ns_prefixed_leaf: bytes) -> bytes:
+    """Host-side NMT leaf digest (for proof verification)."""
+    import hashlib
+
+    ns = ns_prefixed_leaf[:NAMESPACE_SIZE]
+    return ns + ns + hashlib.sha256(b"\x00" + ns_prefixed_leaf).digest()

@@ -1,0 +1,141 @@
+"""Adversarial + property tests.
+
+Mirrors the reference's tier 4 (malicious app fixtures proving honest
+validators reject byzantine proposals, test/util/malicious) and the
+Prepare<->Process consistency fuzz (app/test/fuzz_abci_test.go:26-80).
+"""
+
+import numpy as np
+import pytest
+
+from celestia_tpu.client.signer import Signer
+from celestia_tpu.da.blob import Blob, BlobTx
+from celestia_tpu.da.namespace import Namespace
+from celestia_tpu.node import txsim
+from celestia_tpu.node.malicious import HANDLER_REGISTRY, MaliciousApp
+from celestia_tpu.node.testnode import TestNode
+from celestia_tpu.state.app import App
+from celestia_tpu.state.tx import Fee, MsgPayForBlobs, MsgSend, Tx
+from celestia_tpu.utils.secp256k1 import PrivateKey
+
+
+def _funded_app_and_key(seed=b"malicious-test"):
+    key = PrivateKey.from_seed(seed)
+    addr = key.public_key().address()
+    genesis = {"accounts": [{"address": addr.hex(), "balance": 10**12}]}
+    return genesis, key, addr
+
+
+def _pfb_raw(key, app, n=2, seed=0):
+    """Well-formed signed BlobTxs against the app's current state."""
+    from celestia_tpu.da.inclusion import create_commitment
+    from celestia_tpu.state.modules.blob import estimate_gas
+
+    rng = np.random.default_rng(seed)
+    addr = key.public_key().address()
+    acc = app.accounts.get_or_create(addr)
+    raws = []
+    for i in range(n):
+        data = rng.integers(0, 256, int(rng.integers(200, 3000)), dtype=np.uint8).tobytes()
+        blob = Blob(Namespace.v0(b"mz%d" % i), data)
+        msg = MsgPayForBlobs(
+            signer=addr,
+            namespaces=(blob.namespace.raw,),
+            blob_sizes=(len(blob.data),),
+            share_commitments=(create_commitment(blob),),
+            share_versions=(0,),
+        )
+        gas = estimate_gas([len(blob.data)])
+        tx = Tx(
+            (msg,), Fee(int(gas * 0.002) + 1, gas), key.public_key().compressed(),
+            acc.sequence + i, acc.account_number,
+        ).signed(key, app.chain_id)
+        raws.append(BlobTx(tx=tx.marshal(), blobs=(blob,)).marshal())
+    return raws
+
+
+def test_honest_validator_rejects_out_of_order_square():
+    genesis, key, _ = _funded_app_and_key()
+    byzantine = MaliciousApp(handler="out_of_order")
+    byzantine.init_chain(genesis)
+    honest = App()
+    honest.init_chain(genesis)
+
+    txs = _pfb_raw(key, byzantine, n=2)
+    proposal = byzantine.prepare_proposal(txs)
+    # the byzantine node accepts its own proposal...
+    ok, _ = byzantine.process_proposal(
+        proposal.block_txs, proposal.square_size, proposal.data_root
+    )
+    assert ok
+    # ...but the honest validator rejects it
+    ok, reason = honest.process_proposal(
+        proposal.block_txs, proposal.square_size, proposal.data_root
+    )
+    assert not ok
+    assert "data root mismatch" in reason
+
+
+def test_honest_validator_rejects_lying_data_root():
+    genesis, key, _ = _funded_app_and_key(b"liar")
+    byzantine = MaliciousApp(handler="lying_data_root")
+    byzantine.init_chain(genesis)
+    honest = App()
+    honest.init_chain(genesis)
+    txs = _pfb_raw(key, byzantine, n=1, seed=1)
+    proposal = byzantine.prepare_proposal(txs)
+    ok, reason = honest.process_proposal(
+        proposal.block_txs, proposal.square_size, proposal.data_root
+    )
+    assert not ok and "data root mismatch" in reason
+
+
+def test_unknown_malicious_handler_rejected():
+    with pytest.raises(KeyError, match="unknown malicious handler"):
+        MaliciousApp(handler="nope")
+    assert set(HANDLER_REGISTRY) >= {"out_of_order", "lying_data_root"}
+
+
+def test_prepare_process_consistency_fuzz():
+    """TestPrepareProposalConsistency shape (fuzz_abci_test.go:26-80):
+    random blob/send mixes -> an honest validator always accepts an honest
+    proposer's block."""
+    rng = np.random.default_rng(7)
+    genesis, key, addr = _funded_app_and_key(b"fuzz")
+    for round_i in range(5):
+        proposer = App()
+        proposer.init_chain(genesis)
+        validator = App()
+        validator.init_chain(genesis)
+        # random mix: PFBs + sends + garbage
+        txs = _pfb_raw(key, proposer, n=int(rng.integers(0, 4)), seed=round_i)
+        acc = proposer.accounts.get_or_create(addr)
+        seq = acc.sequence + len(txs)
+        for j in range(int(rng.integers(0, 3))):
+            tx = Tx(
+                (MsgSend(addr, rng.bytes(20), int(rng.integers(1, 100))),),
+                Fee(300, 100_000), key.public_key().compressed(), seq + j, 0,
+            ).signed(key, proposer.chain_id)
+            txs.append(tx.marshal())
+        txs.append(rng.bytes(int(rng.integers(10, 200))))  # garbage tx
+        rng.shuffle(txs)
+        proposal = proposer.prepare_proposal(txs)
+        ok, reason = validator.process_proposal(
+            proposal.block_txs, proposal.square_size, proposal.data_root
+        )
+        assert ok, f"round {round_i}: honest proposal rejected: {reason}"
+
+
+def test_txsim_sequences():
+    node = TestNode()
+    sequences = (
+        txsim.BlobSequence(size_min=100, size_max=1000).clone(2)
+        + [txsim.SendSequence(amount=10), txsim.StakeSequence(amount=1_000_000)]
+    )
+    results = txsim.run(node, sequences, iterations=3, seed=1)
+    assert len(results) == 12
+    failed = [r for r in results if r["code"] != 0]
+    assert not failed, f"txsim failures: {failed[:3]}"
+    kinds = {r["type"] for r in results}
+    assert kinds == {"blob", "send", "stake"}
+    assert node.height > 1

@@ -1,0 +1,122 @@
+"""Inclusion-proof tests (pkg/proof parity tier)."""
+
+import numpy as np
+import pytest
+
+from celestia_tpu.da import dah as dah_mod
+from celestia_tpu.da import proof as proof_mod
+from celestia_tpu.da.blob import Blob, BlobTx
+from celestia_tpu.da.namespace import Namespace
+from celestia_tpu.da.square import build
+from celestia_tpu.ops import nmt as nmt_ops
+
+
+@pytest.fixture(scope="module")
+def chain_block():
+    rng = np.random.default_rng(0)
+    raws = [b"tx-alpha", b"tx-beta-longer-payload" * 10]
+    for i in range(3):
+        raws.append(
+            BlobTx(
+                tx=b"pfb%d" % i,
+                blobs=(Blob(Namespace.v0(b"pf%d" % i), bytes([i + 1]) * (400 * (i + 1))),),
+            ).marshal()
+        )
+    square, block_txs, wrappers = build(raws)
+    eds, dah = dah_mod.extend_block(square)
+    normal = [t for t in block_txs if not t.startswith(b"CTPUBLB0")]
+    wrapped = [w.marshal() for w in wrappers]
+    return square, eds, dah, normal, wrapped
+
+
+def test_merkle_proof_roundtrip():
+    leaves = [b"leaf-%d" % i for i in range(7)]  # non-power-of-two
+    root = bytes(nmt_ops.rfc6962_root_np(leaves))
+    for i in range(7):
+        p = proof_mod.merkle_proof(leaves, i)
+        assert p.verify(root, leaves[i]), f"leaf {i}"
+        assert not p.verify(root, b"wrong")
+        if i != 3:
+            assert not p.verify(root, leaves[3])
+
+
+def test_share_inclusion_proof_verifies(chain_block):
+    square, eds, dah, _, _ = chain_block
+    k = square.size
+    proof = proof_mod.new_share_inclusion_proof(eds, dah, 0, 3)
+    assert proof.verify(dah.hash)
+    # multi-row range
+    proof2 = proof_mod.new_share_inclusion_proof(eds, dah, k - 1, k + 2)
+    assert len(proof2.row_proofs) == 2
+    assert proof2.verify(dah.hash)
+    # full square
+    proof3 = proof_mod.new_share_inclusion_proof(eds, dah, 0, k * k)
+    assert proof3.verify(dah.hash)
+
+
+def test_share_proof_rejects_wrong_root_or_tampered_shares(chain_block):
+    square, eds, dah, _, _ = chain_block
+    proof = proof_mod.new_share_inclusion_proof(eds, dah, 0, 2)
+    assert not proof.verify(b"\x00" * 32)
+    tampered = proof_mod.ShareInclusionProof(
+        proof.start, proof.end, proof.square_size, proof.namespace,
+        (b"\x00" * 512,) + proof.shares[1:], proof.row_proofs, proof.row_roots,
+    )
+    assert not tampered.verify(dah.hash)
+
+
+def test_tx_inclusion_proof(chain_block):
+    square, eds, dah, normal, wrapped = chain_block
+    for tx_index in range(len(normal) + len(wrapped)):
+        proof = proof_mod.new_tx_inclusion_proof(
+            square, eds, dah, normal, wrapped, tx_index
+        )
+        assert proof.verify(dah.hash), f"tx {tx_index}"
+    with pytest.raises(IndexError):
+        proof_mod.tx_share_range(normal, wrapped, len(normal) + len(wrapped))
+
+
+def test_tx_share_range_points_at_compact_shares(chain_block):
+    square, eds, dah, normal, wrapped = chain_block
+    from celestia_tpu.da.namespace import PAY_FOR_BLOB_NAMESPACE, TRANSACTION_NAMESPACE
+
+    s, e = proof_mod.tx_share_range(normal, wrapped, 0)
+    for i in range(s, e):
+        assert square.shares[i].namespace.raw == TRANSACTION_NAMESPACE.raw
+    s, e = proof_mod.tx_share_range(normal, wrapped, len(normal))
+    for i in range(s, e):
+        assert square.shares[i].namespace.raw == PAY_FOR_BLOB_NAMESPACE.raw
+
+
+def test_nmt_range_proof_direct():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    leaves = []
+    for i in range(8):
+        ns = Namespace.v0(bytes([i + 1])).raw
+        leaves.append(ns + rng.integers(0, 256, 40, dtype=np.uint8).tobytes())
+    arr = np.stack([np.frombuffer(x, dtype=np.uint8) for x in leaves])
+    levels = [np.asarray(l) for l in nmt_ops.nmt_level_stack(jnp.asarray(arr))]
+    root = levels[-1][0].tobytes()
+    for start, end in [(0, 1), (2, 5), (0, 8), (7, 8)]:
+        p = proof_mod.nmt_range_proof_from_levels(levels, start, end)
+        assert p.verify(root, leaves[start:end], 8), (start, end)
+        # wrong leaves fail
+        assert not p.verify(root, [leaves[0]] * (end - start), 8) or start == 0 and end == 1
+
+
+def test_share_proof_position_binding(chain_block):
+    """A proof's declared positions must be bound to its row proofs
+    (review-driven): empty or relocated proofs must fail."""
+    square, eds, dah, _, _ = chain_block
+    empty = proof_mod.ShareInclusionProof(0, 1, square.size, b"\x00" * 29, (), (), ())
+    assert not empty.verify(dah.hash)
+    # real proof for shares [k, k+2) presented as if it were [0, 2)
+    k = square.size
+    real = proof_mod.new_share_inclusion_proof(eds, dah, k, k + 2)
+    relocated = proof_mod.ShareInclusionProof(
+        0, 2, k, real.namespace, real.shares, real.row_proofs, real.row_roots
+    )
+    assert not relocated.verify(dah.hash)
+    assert real.verify(dah.hash)

@@ -1,0 +1,110 @@
+"""ctypes bindings for the native C++ host library (native/celestia_native.cpp).
+
+Builds the shared object on demand with g++ (cached by source mtime) and
+exposes the same operations as the device kernels — used as the CPU
+comparison leg in bench.py and as a host fallback.  If no compiler is
+available the module degrades gracefully (``available()`` returns False).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_SRC = _REPO_ROOT / "native" / "celestia_native.cpp"
+_SO = _REPO_ROOT / "native" / "celestia_native.so"
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            [
+                "g++", "-O3", "-march=native", "-shared", "-fPIC",
+                str(_SRC), "-o", str(_SO),
+            ],
+            check=True,
+            capture_output=True,
+            timeout=300,
+        )
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not _SRC.exists():
+        return None
+    if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(str(_SO))
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.rs_extend_square.argtypes = [u8p, u8p, u8p, ctypes.c_int, ctypes.c_int]
+    lib.sha256_batch.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
+    lib.nmt_root.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
+    lib.eds_nmt_roots.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def rs_extend_square(square: np.ndarray) -> np.ndarray:
+    """uint8[k, k, B] -> uint8[2k, 2k, B] (bit-identical to the device)."""
+    from celestia_tpu.ops.gf256 import encode_matrix
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    square = np.ascontiguousarray(square, dtype=np.uint8)
+    k, B = square.shape[0], square.shape[2]
+    E = np.ascontiguousarray(encode_matrix(k))
+    out = np.zeros((2 * k, 2 * k, B), dtype=np.uint8)
+    lib.rs_extend_square(_ptr(square), _ptr(E), _ptr(out), k, B)
+    return out
+
+
+def sha256_batch(msgs: np.ndarray) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    msgs = np.ascontiguousarray(msgs, dtype=np.uint8)
+    n, length = msgs.shape
+    out = np.zeros((n, 32), dtype=np.uint8)
+    lib.sha256_batch(_ptr(msgs), n, length, _ptr(out))
+    return out
+
+
+def eds_nmt_roots(eds: np.ndarray) -> np.ndarray:
+    """uint8[2k, 2k, B] -> uint8[4k, 90] (rows then columns)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    eds = np.ascontiguousarray(eds, dtype=np.uint8)
+    n = eds.shape[0]
+    k = n // 2
+    out = np.zeros((2 * n, 90), dtype=np.uint8)
+    lib.eds_nmt_roots(_ptr(eds), k, eds.shape[2], _ptr(out))
+    return out

@@ -1,0 +1,248 @@
+// Native host library: GF(256) Reed-Solomon square extension + SHA-256 /
+// NMT hashing on the CPU.
+//
+// Role: the TPU framework's equivalent of the reference's performance-native
+// dependencies (Leopard-RS SIMD codec via klauspost/reedsolomon and
+// crypto/sha256 — SURVEY.md §2.2).  Used as (a) the honest CPU comparison
+// leg for bench.py, and (b) a host-side fallback behind the same Python
+// interfaces as the device kernels.  Exposed via a C ABI for ctypes.
+//
+// GF(256): primitive polynomial 0x11D, multiply via a 64 KiB full product
+// table (the classic table method; with -O3 and auto-vectorization this is
+// the strongest portable single-thread baseline short of hand-written
+// pshufb kernels).  Encode matrices arrive from Python (the same Lagrange
+// matrices the device uses), so native and device outputs are bit-identical.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// GF(256)
+// ---------------------------------------------------------------------------
+
+static uint8_t MUL[256][256];
+static int gf_ready = 0;
+
+static uint8_t gf_mul_slow(uint8_t a, uint8_t b) {
+    uint16_t p = 0;
+    uint16_t aa = a;
+    for (int i = 0; i < 8; i++) {
+        if (b & 1) p ^= aa;
+        b >>= 1;
+        aa <<= 1;
+        if (aa & 0x100) aa ^= 0x11D;
+    }
+    return (uint8_t)p;
+}
+
+void gf_init(void) {
+    if (gf_ready) return;
+    for (int a = 0; a < 256; a++)
+        for (int b = 0; b < 256; b++)
+            MUL[a][b] = gf_mul_slow((uint8_t)a, (uint8_t)b);
+    gf_ready = 1;
+}
+
+// parity[i][b] ^= MUL[E[i][j]][data[j][b]] for a row of k shares of B bytes.
+// E: k*k row-major; data: k*B; parity out: k*B.
+static void rs_encode_axis(const uint8_t* E, const uint8_t* data,
+                           uint8_t* parity, int k, int B) {
+    memset(parity, 0, (size_t)k * B);
+    for (int i = 0; i < k; i++) {
+        uint8_t* out = parity + (size_t)i * B;
+        for (int j = 0; j < k; j++) {
+            const uint8_t c = E[i * k + j];
+            if (c == 0) continue;
+            const uint8_t* row = MUL[c];
+            const uint8_t* in = data + (size_t)j * B;
+            for (int b = 0; b < B; b++) out[b] ^= row[in[b]];
+        }
+    }
+}
+
+// Extend a k x k x B square into a 2k x 2k x B EDS (quadrant layout as the
+// device kernel: Q1 row parity, Q2 column parity, Q3 parity of parity).
+// square: k*k*B row-major; eds out: 2k*2k*B; E: k*k encode matrix.
+void rs_extend_square(const uint8_t* square, const uint8_t* E, uint8_t* eds,
+                      int k, int B) {
+    gf_init();
+    const int n = 2 * k;
+    const size_t row_bytes = (size_t)n * B;
+    // Q0
+    for (int r = 0; r < k; r++)
+        memcpy(eds + r * row_bytes, square + (size_t)r * k * B, (size_t)k * B);
+    // Q1: row parity
+    for (int r = 0; r < k; r++)
+        rs_encode_axis(E, eds + r * row_bytes, eds + r * row_bytes + (size_t)k * B,
+                       k, B);
+    // Q2/Q3: column parity over the top half. Gather each column, encode,
+    // scatter. (Columns are strided; gather keeps the inner loop dense.)
+    uint8_t* col = new uint8_t[(size_t)k * B];
+    uint8_t* par = new uint8_t[(size_t)k * B];
+    for (int c = 0; c < n; c++) {
+        for (int r = 0; r < k; r++)
+            memcpy(col + (size_t)r * B, eds + r * row_bytes + (size_t)c * B, B);
+        rs_encode_axis(E, col, par, k, B);
+        for (int r = 0; r < k; r++)
+            memcpy(eds + (size_t)(k + r) * row_bytes + (size_t)c * B,
+                   par + (size_t)r * B, B);
+    }
+    delete[] col;
+    delete[] par;
+}
+
+// ---------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4), portable
+// ---------------------------------------------------------------------------
+
+static const uint32_t K256[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static inline uint32_t rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+}
+
+static void sha256_compress(uint32_t st[8], const uint8_t* block) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+        w[i] = ((uint32_t)block[4 * i] << 24) | ((uint32_t)block[4 * i + 1] << 16) |
+               ((uint32_t)block[4 * i + 2] << 8) | block[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+        uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = st[0], b = st[1], c = st[2], d = st[3];
+    uint32_t e = st[4], f = st[5], g = st[6], h = st[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = h + S1 + ch + K256[i] + w[i];
+        uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+        uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+        uint32_t t2 = S0 + maj;
+        h = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    st[0] += a; st[1] += b; st[2] += c; st[3] += d;
+    st[4] += e; st[5] += f; st[6] += g; st[7] += h;
+}
+
+static void sha256_one(const uint8_t* msg, size_t len, uint8_t out[32]) {
+    uint32_t st[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                      0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+    size_t i = 0;
+    for (; i + 64 <= len; i += 64) sha256_compress(st, msg + i);
+    uint8_t tail[128];
+    size_t rem = len - i;
+    memcpy(tail, msg + i, rem);
+    tail[rem] = 0x80;
+    size_t padded = (rem + 9 <= 64) ? 64 : 128;
+    memset(tail + rem + 1, 0, padded - rem - 9);
+    uint64_t bits = (uint64_t)len * 8;
+    for (int j = 0; j < 8; j++) tail[padded - 1 - j] = (uint8_t)(bits >> (8 * j));
+    for (size_t o = 0; o < padded; o += 64) sha256_compress(st, tail + o);
+    for (int j = 0; j < 8; j++) {
+        out[4 * j] = (uint8_t)(st[j] >> 24);
+        out[4 * j + 1] = (uint8_t)(st[j] >> 16);
+        out[4 * j + 2] = (uint8_t)(st[j] >> 8);
+        out[4 * j + 3] = (uint8_t)st[j];
+    }
+}
+
+// Batch API: n equal-length messages.
+void sha256_batch(const uint8_t* msgs, int n, int len, uint8_t* out) {
+    for (int i = 0; i < n; i++)
+        sha256_one(msgs + (size_t)i * len, len, out + (size_t)i * 32);
+}
+
+// ---------------------------------------------------------------------------
+// NMT roots over an EDS (namespaced digests, ignore-max rule)
+// ---------------------------------------------------------------------------
+
+static const int NS = 29;
+static const int DIGEST = 2 * NS + 32;  // 90
+
+static void nmt_leaf(const uint8_t* ns_prefixed, int len, uint8_t* out) {
+    uint8_t buf[1 + 29 + 4096];
+    buf[0] = 0x00;
+    memcpy(buf + 1, ns_prefixed, len);
+    memcpy(out, ns_prefixed, NS);
+    memcpy(out + NS, ns_prefixed, NS);
+    sha256_one(buf, len + 1, out + 2 * NS);
+}
+
+static void nmt_node(const uint8_t* l, const uint8_t* r, uint8_t* out) {
+    uint8_t buf[1 + 2 * DIGEST];
+    buf[0] = 0x01;
+    memcpy(buf + 1, l, DIGEST);
+    memcpy(buf + 1 + DIGEST, r, DIGEST);
+    memcpy(out, l, NS);  // min = left.min
+    int r_min_is_max = 1;
+    for (int i = 0; i < NS; i++)
+        if (r[i] != 0xFF) { r_min_is_max = 0; break; }
+    memcpy(out + NS, r_min_is_max ? l + NS : r + NS, NS);
+    sha256_one(buf, 1 + 2 * DIGEST, out + 2 * NS);
+}
+
+// Root of one tree whose leaves are ns-prefixed payloads (n a power of two).
+void nmt_root(const uint8_t* leaves, int n, int leaf_len, uint8_t* out) {
+    uint8_t* lvl = new uint8_t[(size_t)n * DIGEST];
+    for (int i = 0; i < n; i++)
+        nmt_leaf(leaves + (size_t)i * leaf_len, leaf_len, lvl + (size_t)i * DIGEST);
+    int m = n;
+    while (m > 1) {
+        for (int i = 0; i < m / 2; i++)
+            nmt_node(lvl + (size_t)(2 * i) * DIGEST,
+                     lvl + (size_t)(2 * i + 1) * DIGEST,
+                     lvl + (size_t)i * DIGEST);
+        m /= 2;
+    }
+    memcpy(out, lvl, DIGEST);
+    delete[] lvl;
+}
+
+// All 4k NMT axis roots of an EDS (2k x 2k x B): rows then columns, each
+// with the Q0 namespace-prefix rule. out: (4k) x 90.
+void eds_nmt_roots(const uint8_t* eds, int k, int B, uint8_t* out) {
+    const int n = 2 * k;
+    const int leaf_len = NS + B;
+    uint8_t* leaves = new uint8_t[(size_t)n * leaf_len];
+    // rows
+    for (int r = 0; r < n; r++) {
+        for (int c = 0; c < n; c++) {
+            const uint8_t* cell = eds + ((size_t)r * n + c) * B;
+            uint8_t* leaf = leaves + (size_t)c * leaf_len;
+            if (r < k && c < k) memcpy(leaf, cell, NS);
+            else memset(leaf, 0xFF, NS);
+            memcpy(leaf + NS, cell, B);
+        }
+        nmt_root(leaves, n, leaf_len, out + (size_t)r * DIGEST);
+    }
+    // columns
+    for (int c = 0; c < n; c++) {
+        for (int r = 0; r < n; r++) {
+            const uint8_t* cell = eds + ((size_t)r * n + c) * B;
+            uint8_t* leaf = leaves + (size_t)r * leaf_len;
+            if (r < k && c < k) memcpy(leaf, cell, NS);
+            else memset(leaf, 0xFF, NS);
+            memcpy(leaf + NS, cell, B);
+        }
+        nmt_root(leaves, n, leaf_len, out + (size_t)(n + c) * DIGEST);
+    }
+    delete[] leaves;
+}
+
+}  // extern "C"

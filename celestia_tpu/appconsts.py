@@ -86,8 +86,11 @@ DEFAULT_GAS_PER_BLOB_BYTE = 8
 DEFAULT_MIN_GAS_PRICE = 0.002  # utia
 DEFAULT_UNBONDING_TIME_SECONDS = 3 * 7 * 24 * 3600
 
-# v2 global min gas price enforced by x/minfee (v2/app_consts.go:5-9)
-GLOBAL_MIN_GAS_PRICE = 0.002
+# v2 global min gas price enforced by x/minfee (v2/app_consts.go:5-9).
+# Stored and compared as an integer in utia-per-gas parts-per-million:
+# consensus-critical fee math must never touch floats (same rationale as the
+# mint module's integer fixed point).  2000 ppm == 0.002 utia/gas.
+GLOBAL_MIN_GAS_PRICE_PPM = 2000
 
 # --- Consensus timing (consensus_consts.go:5-12) ---
 TIMEOUT_PROPOSE_SECONDS = 10

@@ -150,11 +150,24 @@ class ByzantineError(ValueError):
     disagree with the polynomial through the rest of their row/column."""
 
 
-def repair_square(eds: np.ndarray, available: np.ndarray) -> np.ndarray:
+def repair_square(
+    eds: np.ndarray,
+    available: np.ndarray,
+    row_roots: np.ndarray = None,
+    col_roots: np.ndarray = None,
+) -> np.ndarray:
     """Reconstruct a full EDS from a partial one (rsmt2d.Repair parity).
 
     eds: uint8[2k, 2k, B] with garbage in unavailable cells;
-    available: bool[2k, 2k] marking cells present.
+    available: bool[2k, 2k] marking cells present;
+    row_roots / col_roots: optional uint8[2k, 90] committed NMT axis roots
+    from the block's DAH.  When given, every axis of the reconstructed
+    square is re-hashed and checked against its commitment — without this,
+    a malicious provider supplying k internally-consistent but *wrong*
+    shares per axis would yield a "successful" reconstruction that does not
+    match the block (rsmt2d.Repair verifies rebuilt axes against the
+    committed roots for exactly this reason).
+
     Iteratively solves every row/column with >= k available cells, batching
     axes that share an availability mask into one device matmul, until the
     square is complete.  Raises ValueError if reconstruction stalls
@@ -162,7 +175,8 @@ def repair_square(eds: np.ndarray, available: np.ndarray) -> np.ndarray:
     :class:`ByzantineError` if the provided shares are not a consistent
     codeword: after completion the square is re-extended from Q0 and every
     originally-available cell must match what was provided (this also
-    catches inconsistent fully-available axes that need no solving).
+    catches inconsistent fully-available axes that need no solving), then
+    checked against the committed roots when supplied.
     """
     original_eds = np.array(eds, dtype=np.uint8, copy=True)
     eds = np.array(eds, dtype=np.uint8, copy=True)
@@ -222,6 +236,27 @@ def repair_square(eds: np.ndarray, available: np.ndarray) -> np.ndarray:
             f"provided shares disagree with the reconstructed codeword at "
             f"cells {list(zip(*bad))[:8]}"
         )
+    if row_roots is not None or col_roots is not None:
+        from celestia_tpu.ops import nmt as nmt_ops
+
+        roots = np.asarray(nmt_ops.eds_nmt_roots(eds))
+        for name, axis_roots, got in (
+            ("row", row_roots, roots[0]),
+            ("col", col_roots, roots[1]),
+        ):
+            if axis_roots is None:
+                continue
+            axis_roots = np.asarray(axis_roots, dtype=np.uint8)
+            if axis_roots.shape != got.shape:
+                raise ValueError(
+                    f"{name}_roots must be {got.shape}, got {axis_roots.shape}"
+                )
+            bad = np.nonzero((axis_roots != got).any(axis=1))[0]
+            if len(bad):
+                raise ByzantineError(
+                    f"reconstructed {name} axes {bad.tolist()[:8]} do not "
+                    f"match the committed NMT roots"
+                )
     return eds
 
 

@@ -17,7 +17,7 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
 from celestia_tpu.appconsts import (
-    GLOBAL_MIN_GAS_PRICE,
+    GLOBAL_MIN_GAS_PRICE_PPM,
     SHARE_SIZE,
     square_size_upper_bound,
 )
@@ -120,12 +120,16 @@ def check_and_deduct_fee(ctx: AnteContext) -> None:
     price (x/minfee) and the node-local one (CheckTx), then move the fee to
     the fee collector."""
     tx = ctx.tx
-    network_min = ctx.params.get("minfee", "NetworkMinGasPrice", GLOBAL_MIN_GAS_PRICE)
-    required = tx.fee.gas_limit * network_min
-    if tx.fee.amount < required:
+    # Consensus-critical comparison in pure integer math (utia-per-gas ppm):
+    # fee * 1e6 >= gas_limit * min_ppm.
+    min_ppm = int(
+        ctx.params.get("minfee", "NetworkMinGasPricePpm", GLOBAL_MIN_GAS_PRICE_PPM)
+    )
+    if tx.fee.amount * 1_000_000 < tx.fee.gas_limit * min_ppm:
+        required = -(-tx.fee.gas_limit * min_ppm // 1_000_000)  # ceil div
         raise AnteError(
-            f"insufficient fee: got {tx.fee.amount}utia, required {required:.0f}utia "
-            f"(network min gas price {network_min})"
+            f"insufficient fee: got {tx.fee.amount}utia, required {required}utia "
+            f"(network min gas price {min_ppm}ppm)"
         )
     if ctx.is_check_tx and ctx.min_gas_price > 0:
         local_required = tx.fee.gas_limit * ctx.min_gas_price

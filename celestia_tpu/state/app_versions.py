@@ -81,10 +81,10 @@ def run_migrations(app, from_version: int, to_version: int) -> List[str]:
 
 def _migrate_v2_minfee(app) -> None:
     """v1 -> v2: introduce the x/minfee network min gas price param."""
-    from celestia_tpu.appconsts import GLOBAL_MIN_GAS_PRICE
+    from celestia_tpu.appconsts import GLOBAL_MIN_GAS_PRICE_PPM
 
-    if not app.params.has("minfee", "NetworkMinGasPrice"):
-        app.params.set("minfee", "NetworkMinGasPrice", GLOBAL_MIN_GAS_PRICE)
+    if not app.params.has("minfee", "NetworkMinGasPricePpm"):
+        app.params.set("minfee", "NetworkMinGasPricePpm", GLOBAL_MIN_GAS_PRICE_PPM)
 
 
 register_migration(V2_VERSION, _migrate_v2_minfee)

@@ -15,7 +15,7 @@ from celestia_tpu.appconsts import (
     DEFAULT_GAS_PER_BLOB_BYTE,
     DEFAULT_GOV_MAX_SQUARE_SIZE,
     DEFAULT_UNBONDING_TIME_SECONDS,
-    GLOBAL_MIN_GAS_PRICE,
+    GLOBAL_MIN_GAS_PRICE_PPM,
 )
 from celestia_tpu.state.store import KVStore
 
@@ -74,7 +74,7 @@ def set_default_params(params: ParamsKeeper) -> None:
     x/blob params at x/blob keeper defaults)."""
     params.set("blob", "GasPerBlobByte", DEFAULT_GAS_PER_BLOB_BYTE)
     params.set("blob", "GovMaxSquareSize", DEFAULT_GOV_MAX_SQUARE_SIZE)
-    params.set("minfee", "NetworkMinGasPrice", GLOBAL_MIN_GAS_PRICE)
+    params.set("minfee", "NetworkMinGasPricePpm", GLOBAL_MIN_GAS_PRICE_PPM)
     params.set("staking", "BondDenom", "utia")
     params.set("staking", "UnbondingTime", DEFAULT_UNBONDING_TIME_SECONDS)
     params.set("staking", "MaxValidators", 100)

@@ -141,6 +141,14 @@ class PublicKey:
         s = int.from_bytes(sig[32:], "big")
         if not (1 <= r < N and 1 <= s < N):
             return False
+        if s > N // 2:
+            # Reject non-canonical high-s signatures.  Accepting (r, N-s)
+            # alongside (r, s) lets any third party malleate an in-flight tx
+            # into a different tx hash that still executes — breaking
+            # confirm-by-hash lookup and mempool dedup.  Mirrors the low-s
+            # rule sign() already enforces and the reference's secp256k1
+            # behavior (SURVEY.md §2.2).
+            return False
         z = int.from_bytes(hashlib.sha256(msg).digest(), "big") % N
         w = _inv(s, N)
         u1 = z * w % N

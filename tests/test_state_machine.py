@@ -51,6 +51,20 @@ def test_secp256k1_sign_verify():
     assert PublicKey.from_compressed(pub.compressed()) == pub
 
 
+def test_secp256k1_rejects_malleated_high_s():
+    """(r, N-s) must NOT verify: accepting it would let a third party
+    malleate an in-flight tx into a different hash that still executes."""
+    from celestia_tpu.utils.secp256k1 import N
+
+    key = PrivateKey.from_seed(b"alice")
+    pub = key.public_key()
+    sig = key.sign(b"message")
+    r, s = sig[:32], int.from_bytes(sig[32:], "big")
+    assert s <= N // 2  # sign() emits canonical low-s
+    high_s = r + (N - s).to_bytes(32, "big")
+    assert not pub.verify(b"message", high_s)
+
+
 # --- store ------------------------------------------------------------------
 
 

@@ -46,7 +46,7 @@ def test_upgrade_signal_quorum_flow():
     node2.produce_blocks(2)  # heights 2,3 -> end of height 2 == upgradeHeight-1
     assert node2.app.app_version == 2
     # minfee migration ran
-    assert node2.app.params.get("minfee", "NetworkMinGasPrice") == 0.002
+    assert node2.app.params.get("minfee", "NetworkMinGasPricePpm") == 2000
 
     # v2: signal + try-upgrade to v3 via 5/6 quorum (single validator = 100%)
     s2 = Signer(node2, node2._validator_key)

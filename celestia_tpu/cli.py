@@ -1,0 +1,443 @@
+"""celestia-tpu — the node daemon + client command tree.
+
+Parity with the reference CLI (cmd/celestia-appd/cmd/root.go:55-161):
+``init``, ``start``, ``keys``, ``tx`` (bank send / blob pay-for-blob),
+``query`` (balance / tx / block / status / proof), ``status``, plus the
+``blocktime`` tool (tools/blocktime/main.go:20-96).
+
+Run as ``python -m celestia_tpu.cli <command>`` or via the celestia-tpu
+entry point.  The ``start`` command serves the gRPC node service
+(node/server.py) that every client command talks to over the network.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+DEFAULT_HOME = os.path.expanduser("~/.celestia-tpu")
+
+
+def _home(args) -> str:
+    return args.home or os.environ.get("CELESTIA_HOME", DEFAULT_HOME)
+
+
+# ---------------------------------------------------------------------------
+# keyring (file-backed, seed keys)
+# ---------------------------------------------------------------------------
+
+
+def _keyring_dir(home: str) -> Path:
+    d = Path(home) / "keyring"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _load_key(home: str, name: str):
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+
+    path = _keyring_dir(home) / f"{name}.json"
+    if not path.exists():
+        raise SystemExit(f"key {name!r} not found in {path.parent}")
+    info = json.loads(path.read_text())
+    return PrivateKey(int(info["priv"], 16))
+
+
+def cmd_keys(args) -> int:
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+
+    home = _home(args)
+    kd = _keyring_dir(home)
+    if args.keys_cmd == "add":
+        path = kd / f"{args.name}.json"
+        if path.exists():
+            raise SystemExit(f"key {args.name!r} already exists")
+        key = PrivateKey.from_seed(os.urandom(32))
+        addr = key.public_key().address()
+        path.write_text(
+            json.dumps({"priv": f"{key.d:064x}", "address": addr.hex()})
+        )
+        print(json.dumps({"name": args.name, "address": addr.hex()}))
+    elif args.keys_cmd == "list":
+        for p in sorted(kd.glob("*.json")):
+            info = json.loads(p.read_text())
+            print(json.dumps({"name": p.stem, "address": info["address"]}))
+    elif args.keys_cmd == "show":
+        key = _load_key(home, args.name)
+        print(
+            json.dumps(
+                {
+                    "name": args.name,
+                    "address": key.public_key().address().hex(),
+                    "pubkey": key.public_key().compressed().hex(),
+                }
+            )
+        )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# init / start
+# ---------------------------------------------------------------------------
+
+
+def cmd_init(args) -> int:
+    from celestia_tpu.node.config import init_home
+
+    home = _home(args)
+    extra = []
+    if args.fund_keyring:
+        for p in sorted(_keyring_dir(home).glob("*.json")):
+            info = json.loads(p.read_text())
+            extra.append((bytes.fromhex(info["address"]), args.fund_keyring))
+    root = init_home(
+        home, chain_id=args.chain_id, overwrite=args.overwrite,
+        extra_accounts=extra,
+    )
+    print(
+        json.dumps(
+            {
+                "home": str(root),
+                "chain_id": args.chain_id,
+                "funded_accounts": len(extra),
+            }
+        )
+    )
+    return 0
+
+
+def cmd_start(args) -> int:
+    from celestia_tpu.node.config import load_config
+    from celestia_tpu.node.server import NodeServer
+    from celestia_tpu.node.testnode import TestNode
+    from celestia_tpu.utils.logging import Logger
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+
+    home = _home(args)
+    overrides = {}
+    if args.grpc_address:
+        overrides["grpc.address"] = args.grpc_address
+    if args.block_interval is not None:
+        overrides["consensus.block_interval_s"] = args.block_interval
+    if args.v2_upgrade_height is not None:
+        overrides["v2_upgrade_height"] = args.v2_upgrade_height
+    cfg = load_config(home, overrides=overrides)
+    log = Logger(level=cfg.log.level, fmt=cfg.log.format, to_file=cfg.log.to_file)
+
+    genesis_path = Path(home) / "config" / "genesis.json"
+    if not genesis_path.exists():
+        raise SystemExit(f"no genesis at {genesis_path}; run `init` first")
+    genesis = json.loads(genesis_path.read_text())
+    key_path = Path(home) / "config" / "priv_validator_key.json"
+    validator_key = None
+    if key_path.exists():
+        validator_key = PrivateKey(
+            int(json.loads(key_path.read_text())["priv_key"], 16)
+        )
+
+    snapshot_dir = str(Path(home) / "data" / "snapshots")
+    from celestia_tpu.node.snapshots import SnapshotStore
+
+    latest_snap = SnapshotStore(snapshot_dir).latest()
+    if latest_snap is not None:
+        # restart path: resume from the latest state-sync snapshot instead
+        # of silently resetting to genesis (root.go:227-243 restore wiring)
+        node = TestNode.from_snapshot(
+            snapshot_dir,
+            block_interval_ns=int(cfg.consensus.block_interval_s * 1e9),
+            auto_produce=False,
+            snapshot_interval=cfg.snapshot.interval,
+            snapshot_keep_recent=cfg.snapshot.keep_recent,
+            validator_key=validator_key,
+            min_gas_price=cfg.min_gas_price,
+            v2_upgrade_height=cfg.v2_upgrade_height,
+        )
+        log.info(
+            "restored from snapshot",
+            height=latest_snap.height,
+            app_hash=latest_snap.app_hash.hex()[:16],
+        )
+    else:
+        node = TestNode(
+            chain_id=genesis.get("chain_id", cfg.chain_id),
+            genesis=genesis,
+            validator_key=validator_key,
+            block_interval_ns=int(cfg.consensus.block_interval_s * 1e9),
+            auto_produce=False,
+            min_gas_price=cfg.min_gas_price,
+            v2_upgrade_height=cfg.v2_upgrade_height,
+            snapshot_dir=snapshot_dir,
+            snapshot_interval=cfg.snapshot.interval,
+            snapshot_keep_recent=cfg.snapshot.keep_recent,
+        )
+    server = NodeServer(
+        node,
+        address=cfg.grpc.address,
+        block_interval_s=cfg.consensus.block_interval_s,
+    )
+    server.start()
+    log.info(
+        "node started",
+        chain_id=node.chain_id,
+        grpc=server.address,
+        block_interval_s=cfg.consensus.block_interval_s,
+    )
+    print(json.dumps({"grpc": server.address, "chain_id": node.chain_id}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        log.info("shutting down")
+        server.stop()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# tx / query (remote client commands)
+# ---------------------------------------------------------------------------
+
+
+def _remote(args):
+    from celestia_tpu.client.remote import RemoteNode
+
+    return RemoteNode(args.node, timeout_s=getattr(args, "timeout", 120.0))
+
+
+def cmd_tx(args) -> int:
+    from celestia_tpu.client.signer import Signer
+
+    home = _home(args)
+    node = _remote(args)
+    key = _load_key(home, getattr(args, "from_key"))
+    signer = Signer(node, key)
+    if args.tx_cmd == "send":
+        from celestia_tpu.state.tx import MsgSend
+
+        msg = MsgSend(
+            from_addr=signer.address,
+            to_addr=bytes.fromhex(args.to),
+            amount=int(args.amount),
+        )
+        res = signer.submit_tx([msg])
+    elif args.tx_cmd == "pay-for-blob":
+        from celestia_tpu.da.blob import Blob
+        from celestia_tpu.da.namespace import Namespace
+
+        if args.data.startswith("@"):
+            data = Path(args.data[1:]).read_bytes()
+        else:
+            data = bytes.fromhex(args.data)
+        ns = Namespace.v0(bytes.fromhex(args.namespace))
+        res = signer.submit_pay_for_blob([Blob(ns, data)])
+    else:  # pragma: no cover
+        raise SystemExit(f"unknown tx command {args.tx_cmd}")
+    # submit_tx / submit_pay_for_blob broadcast AND poll-confirm; the
+    # result carries the inclusion height
+    out = {
+        "code": res.code,
+        "txhash": res.tx_hash.hex(),
+        "log": res.log,
+        "height": res.height,
+    }
+    print(json.dumps(out))
+    return 0 if res.code == 0 else 1
+
+
+def cmd_query(args) -> int:
+    node = _remote(args)
+    if args.query_cmd == "balance":
+        value = node.abci_query("store/bank/balance", {"address": args.address})
+        print(json.dumps({"address": args.address, "balance": value}))
+    elif args.query_cmd == "account":
+        value = node.abci_query("custom/auth/account", {"address": args.address})
+        print(json.dumps(value))
+    elif args.query_cmd == "tx":
+        info = node.get_tx(bytes.fromhex(args.hash))
+        print(json.dumps(info if info else {"found": False}))
+    elif args.query_cmd == "block":
+        print(json.dumps(node.block(int(args.height))))
+    elif args.query_cmd == "param":
+        value = node.abci_query(
+            "custom/params/param", {"subspace": args.subspace, "key": args.key}
+        )
+        print(json.dumps({"value": value}))
+    elif args.query_cmd == "share-proof":
+        value = node.abci_query(
+            "custom/proof/share",
+            {"height": args.height, "start": args.start, "end": args.end},
+        )
+        print(json.dumps(value))
+    elif args.query_cmd == "tx-proof":
+        value = node.abci_query(
+            "custom/proof/tx", {"height": args.height, "tx_index": args.index}
+        )
+        print(json.dumps(value))
+    return 0
+
+
+def cmd_status(args) -> int:
+    print(json.dumps(_remote(args).status()))
+    return 0
+
+
+def cmd_snapshot(args) -> int:
+    from celestia_tpu.node.snapshots import SnapshotStore
+
+    store = SnapshotStore(str(Path(_home(args)) / "data" / "snapshots"))
+    if args.snap_cmd == "list":
+        for info in store.list():
+            print(
+                json.dumps(
+                    {
+                        "height": info.height,
+                        "chunks": info.chunks,
+                        "app_hash": info.app_hash.hex(),
+                        "app_version": info.app_version,
+                    }
+                )
+            )
+    elif args.snap_cmd == "info":
+        for info in store.list():
+            if info.height == args.height:
+                meta = store.load_state(info)
+                print(
+                    json.dumps(
+                        {
+                            "height": info.height,
+                            "chain_id": info.chain_id,
+                            "stores": sorted(meta["state"]),
+                            "app_hash": info.app_hash.hex(),
+                        }
+                    )
+                )
+                return 0
+        raise SystemExit(f"no snapshot at height {args.height}")
+    return 0
+
+
+def cmd_blocktime(args) -> int:
+    """Average block interval over a height range (tools/blocktime)."""
+    node = _remote(args)
+    last = args.to_height or node.height
+    first = max(2, args.from_height)
+    if last <= first:
+        raise SystemExit("need at least two blocks in range")
+    t0 = node.block(first - 1)["time_ns"]
+    t1 = node.block(last)["time_ns"]
+    avg_s = (t1 - t0) / (last - first + 1) / 1e9
+    print(
+        json.dumps(
+            {"from": first, "to": last, "avg_block_time_s": round(avg_s, 3)}
+        )
+    )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="celestia-tpu")
+    p.add_argument("--home", default=None, help="node home directory")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("init", help="initialise a node home")
+    sp.add_argument("--chain-id", default="celestia-tpu-1")
+    sp.add_argument("--overwrite", action="store_true")
+    sp.add_argument(
+        "--fund-keyring", type=int, default=0, metavar="UTIA",
+        help="fund every key already in the home keyring with this balance",
+    )
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run the node + gRPC service")
+    sp.add_argument("--grpc-address", default=None)
+    sp.add_argument("--block-interval", type=float, default=None)
+    sp.add_argument("--v2-upgrade-height", type=int, default=None)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("keys", help="manage the file keyring")
+    ks = sp.add_subparsers(dest="keys_cmd", required=True)
+    ka = ks.add_parser("add")
+    ka.add_argument("name")
+    ks.add_parser("list")
+    kw = ks.add_parser("show")
+    kw.add_argument("name")
+    sp.set_defaults(fn=cmd_keys)
+
+    sp = sub.add_parser("tx", help="sign + broadcast transactions")
+    sp.add_argument("--node", default="127.0.0.1:9090")
+    sp.add_argument("--timeout", type=float, default=120.0,
+                    help="per-RPC timeout in seconds")
+    sp.add_argument("--from", dest="from_key", required=True)
+    sp.add_argument("--no-confirm", action="store_true")
+    ts = sp.add_subparsers(dest="tx_cmd", required=True)
+    t1 = ts.add_parser("send")
+    t1.add_argument("to")
+    t1.add_argument("amount")
+    t2 = ts.add_parser("pay-for-blob")
+    t2.add_argument("namespace", help="hex user namespace (<=10 bytes)")
+    t2.add_argument("data", help="hex blob data, or @file")
+    sp.set_defaults(fn=cmd_tx)
+
+    sp = sub.add_parser("query", help="query node state")
+    sp.add_argument("--node", default="127.0.0.1:9090")
+    sp.add_argument("--timeout", type=float, default=120.0,
+                    help="per-RPC timeout in seconds")
+    qs = sp.add_subparsers(dest="query_cmd", required=True)
+    q = qs.add_parser("balance")
+    q.add_argument("address")
+    q = qs.add_parser("account")
+    q.add_argument("address")
+    q = qs.add_parser("tx")
+    q.add_argument("hash")
+    q = qs.add_parser("block")
+    q.add_argument("height")
+    q = qs.add_parser("param")
+    q.add_argument("subspace")
+    q.add_argument("key")
+    q = qs.add_parser("share-proof")
+    q.add_argument("height", type=int)
+    q.add_argument("start", type=int)
+    q.add_argument("end", type=int)
+    q = qs.add_parser("tx-proof")
+    q.add_argument("height", type=int)
+    q.add_argument("index", type=int)
+    sp.set_defaults(fn=cmd_query)
+
+    sp = sub.add_parser("status", help="node status")
+    sp.add_argument("--node", default="127.0.0.1:9090")
+    sp.add_argument("--timeout", type=float, default=120.0,
+                    help="per-RPC timeout in seconds")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("snapshot", help="manage state-sync snapshots")
+    ss = sp.add_subparsers(dest="snap_cmd", required=True)
+    ss.add_parser("list")
+    sr = ss.add_parser("info")
+    sr.add_argument("height", type=int)
+    sp.set_defaults(fn=cmd_snapshot)
+
+    sp = sub.add_parser("blocktime", help="average block interval")
+    sp.add_argument("--node", default="127.0.0.1:9090")
+    sp.add_argument("--timeout", type=float, default=120.0,
+                    help="per-RPC timeout in seconds")
+    sp.add_argument("--from-height", type=int, default=2)
+    sp.add_argument("--to-height", type=int, default=0)
+    sp.set_defaults(fn=cmd_blocktime)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""gRPC client: a network-remote node with the same surface as TestNode.
+
+The Signer (client/signer.py) binds to anything exposing broadcast_tx /
+account_info / simulate / get_tx / chain_id — in-process TestNode or this
+class over a real network boundary.  Parity role: the gRPC connection
+pkg/user's Signer holds (pkg/user/signer.go:31-55, broadcast :268-309,
+ConfirmTx poll :365-395).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+import grpc
+
+from celestia_tpu.client.signer import SubmitResult
+
+SERVICE = "celestia.tpu.v1.Node"
+
+
+class RemoteError(RuntimeError):
+    pass
+
+
+class RemoteNode:
+    """Client handle to a celestia-tpu node's gRPC service."""
+
+    def __init__(self, address: str, timeout_s: float = 30.0):
+        self.address = address
+        self.timeout_s = timeout_s
+        self._channel = grpc.insecure_channel(address)
+        self._methods: dict = {}
+        status = self.status()
+        self.chain_id = status["chain_id"]
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def _call(self, method: str, payload: bytes) -> bytes:
+        fn = self._methods.get(method)
+        if fn is None:
+            fn = self._channel.unary_unary(
+                f"/{SERVICE}/{method}",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            self._methods[method] = fn
+        try:
+            return fn(payload, timeout=self.timeout_s)
+        except grpc.RpcError as e:
+            raise RemoteError(f"{method}: {e.code().name} {e.details()}") from e
+
+    def _call_json(self, method: str, obj: dict) -> dict:
+        return json.loads(self._call(method, json.dumps(obj).encode()))
+
+    # -- TestNode-compatible client surface ----------------------------
+
+    def status(self) -> dict:
+        return self._call_json("Status", {})
+
+    @property
+    def height(self) -> int:
+        return int(self.status()["height"])
+
+    def account_info(self, address: bytes):
+        out = self._call_json("AccountInfo", {"address": address.hex()})
+        return out["account_number"], out["sequence"]
+
+    def broadcast_tx(self, raw: bytes) -> SubmitResult:
+        out = json.loads(self._call("Broadcast", raw))
+        return SubmitResult(
+            out["code"], out["log"], bytes.fromhex(out["txhash"])
+        )
+
+    def get_tx(self, tx_hash: bytes) -> Optional[dict]:
+        try:
+            out = self._call_json("GetTx", {"hash": tx_hash.hex()})
+        except RemoteError as e:
+            if "DEADLINE_EXCEEDED" in str(e):
+                # the node is busy (e.g. a cold XLA compile inside block
+                # production holds the service lock); treat as "not yet"
+                # so confirm loops keep polling instead of dying
+                return None
+            raise
+        if not out.pop("found"):
+            return None
+        return out
+
+    def simulate(self, raw: bytes) -> int:
+        out = json.loads(self._call("Simulate", raw))
+        if "gas" not in out:
+            raise ValueError(out.get("log", "simulation failed"))
+        return int(out["gas"])
+
+    def block(self, height: int) -> dict:
+        out = self._call_json("Block", {"height": height})
+        if not out.pop("found"):
+            raise KeyError(f"no block at height {height}")
+        return out
+
+    def data_root(self, height: int) -> bytes:
+        return bytes.fromhex(self.block(height)["data_root"])
+
+    def abci_query(self, path: str, data: dict):
+        out = self._call_json("Query", {"path": path, "data": data})
+        if out.get("code"):
+            raise RemoteError(out.get("log", "query failed"))
+        return out["value"]
+
+    def wait_for_height(self, h: int, timeout_s: float = 60.0) -> None:
+        deadline = time.time() + timeout_s
+        while self.height < h:
+            if time.time() > deadline:
+                raise TimeoutError(f"height {h} not reached in {timeout_s}s")
+            time.sleep(0.05)

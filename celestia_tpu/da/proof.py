@@ -244,6 +244,70 @@ class ShareInclusionProof:
                 return False
         return share_i == len(self.shares)
 
+    # -- wire form (JSON-safe dict) — lets the node API serve proofs
+    #    (pkg/proof/querier.go routes) and clients re-verify them --------
+
+    def to_dict(self) -> dict:
+        return {
+            "start": self.start,
+            "end": self.end,
+            "square_size": self.square_size,
+            "namespace": self.namespace.hex(),
+            "shares": [s.hex() for s in self.shares],
+            "row_roots": [r.hex() for r in self.row_roots],
+            "row_proofs": [
+                {
+                    "row": rp.row,
+                    "start_col": rp.start_col,
+                    "end_col": rp.end_col,
+                    "nmt": {
+                        "start": rp.nmt_proof.start,
+                        "end": rp.nmt_proof.end,
+                        "nodes": [n.hex() for n in rp.nmt_proof.nodes],
+                    },
+                    "root": {
+                        "index": rp.root_proof.index,
+                        "total": rp.root_proof.total,
+                        "aunts": [a.hex() for a in rp.root_proof.aunts],
+                    },
+                }
+                for rp in self.row_proofs
+            ],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ShareInclusionProof":
+        return cls(
+            start=int(d["start"]),
+            end=int(d["end"]),
+            square_size=int(d["square_size"]),
+            namespace=bytes.fromhex(d["namespace"]),
+            shares=tuple(bytes.fromhex(s) for s in d["shares"]),
+            row_proofs=tuple(
+                RowShareProof(
+                    row=int(rp["row"]),
+                    start_col=int(rp["start_col"]),
+                    end_col=int(rp["end_col"]),
+                    nmt_proof=NmtRangeProof(
+                        start=int(rp["nmt"]["start"]),
+                        end=int(rp["nmt"]["end"]),
+                        nodes=tuple(
+                            bytes.fromhex(n) for n in rp["nmt"]["nodes"]
+                        ),
+                    ),
+                    root_proof=MerkleProof(
+                        index=int(rp["root"]["index"]),
+                        total=int(rp["root"]["total"]),
+                        aunts=tuple(
+                            bytes.fromhex(a) for a in rp["root"]["aunts"]
+                        ),
+                    ),
+                )
+                for rp in d["row_proofs"]
+            ),
+            row_roots=tuple(bytes.fromhex(r) for r in d["row_roots"]),
+        )
+
 
 def new_share_inclusion_proof(
     eds: ExtendedDataSquare,

@@ -28,6 +28,8 @@ class Mempool:
         self.max_tx_bytes = max_tx_bytes
         self.ttl_blocks = ttl_blocks
         self._txs: Dict[bytes, MempoolTx] = {}
+        self._order: Dict[bytes, int] = {}  # insertion sequence (FIFO ties)
+        self._counter = 0
 
     def __len__(self) -> int:
         return len(self._txs)
@@ -40,16 +42,22 @@ class Mempool:
         h = hashlib.sha256(raw).digest()
         if h not in self._txs:
             self._txs[h] = MempoolTx(raw, gas_price, height, h)
+            self._order[h] = self._counter
+            self._counter += 1
         return h
 
     def remove(self, tx_hash: bytes) -> None:
         self._txs.pop(tx_hash, None)
+        self._order.pop(tx_hash, None)
 
     def reap(self, max_txs: Optional[int] = None) -> List[MempoolTx]:
-        """Highest gas price first; FIFO within equal price (priority
-        ordering drives blob placement — data_square_layout.md 'Ordering')."""
+        """Highest gas price first; strict FIFO within equal price (comet's
+        prioritized mempool v1 ordering — a same-account sequence chain at
+        one gas price must come out in submission order or FilterTxs drops
+        the later nonces; data_square_layout.md 'Ordering')."""
         ordered = sorted(
-            self._txs.values(), key=lambda t: (-t.gas_price, t.added_height, t.tx_hash)
+            self._txs.values(),
+            key=lambda t: (-t.gas_price, self._order[t.tx_hash]),
         )
         return ordered if max_txs is None else ordered[:max_txs]
 
@@ -61,4 +69,5 @@ class Mempool:
         ]
         for h in expired:
             del self._txs[h]
+            self._order.pop(h, None)
         return len(expired)

@@ -1,0 +1,220 @@
+"""gRPC node service: the network boundary between clients and a node.
+
+Role parity: the reference node exposes gRPC/RPC services that pkg/user's
+Signer talks to (app/app.go:826-852 wires the API/gRPC services;
+pkg/user/signer.go:278-309 broadcasts over gRPC and polls GetTx).  Here the
+same surface is served with grpc generic handlers (no codegen): every method
+is bytes -> bytes, with JSON envelopes for control-plane calls and raw tx
+bytes for broadcast.
+
+Methods (service ``celestia.tpu.v1.Node``):
+  Broadcast    raw BlobTx/Tx bytes        -> {code, log, txhash}
+  GetTx        {"hash": hex}              -> tx status or {"found": false}
+  AccountInfo  {"address": hex}           -> {account_number, sequence}
+  Simulate     raw tx bytes               -> {gas} | {code, log}
+  Status       {}                         -> chain/app status
+  Block        {"height": N}              -> header + tx hashes
+  Query        {"path": str, "data": {}}  -> ABCI-style query routes,
+               including the proof routes (custom/proof/share,
+               custom/proof/tx — pkg/proof/querier.go parity).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+SERVICE = "celestia.tpu.v1.Node"
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class NodeService:
+    """Method implementations over an in-process node (TestNode surface)."""
+
+    def __init__(self, node):
+        self.node = node
+
+    # -- handlers (bytes -> bytes) ------------------------------------
+
+    def broadcast(self, raw: bytes, ctx) -> bytes:
+        res = self.node.broadcast_tx(raw)
+        return json.dumps(
+            {"code": res.code, "log": res.log, "txhash": res.tx_hash.hex()}
+        ).encode()
+
+    def get_tx(self, req: bytes, ctx) -> bytes:
+        q = json.loads(req or b"{}")
+        info = self.node.get_tx(bytes.fromhex(q["hash"]))
+        if info is None:
+            return json.dumps({"found": False}).encode()
+        out = {"found": True}
+        for key, val in info.items():
+            out[key] = val.hex() if isinstance(val, bytes) else val
+        return json.dumps(out, default=str).encode()
+
+    def account_info(self, req: bytes, ctx) -> bytes:
+        q = json.loads(req or b"{}")
+        num, seq = self.node.account_info(bytes.fromhex(q["address"]))
+        return json.dumps({"account_number": num, "sequence": seq}).encode()
+
+    def simulate(self, raw: bytes, ctx) -> bytes:
+        try:
+            gas = self.node.simulate(raw)
+            return json.dumps({"gas": gas}).encode()
+        except Exception as e:
+            return json.dumps({"code": 1, "log": str(e)}).encode()
+
+    def status(self, req: bytes, ctx) -> bytes:
+        node = self.node
+        blocks = getattr(node, "blocks", [])
+        latest = blocks[-1].header if blocks else None
+        return json.dumps(
+            {
+                "chain_id": node.chain_id,
+                "height": node.height,
+                "app_version": node.app.app_version,
+                "app_hash": latest.app_hash.hex() if latest else "",
+                "data_root": latest.data_hash.hex() if latest else "",
+                "time_ns": latest.time_ns if latest else 0,
+            }
+        ).encode()
+
+    def block(self, req: bytes, ctx) -> bytes:
+        q = json.loads(req or b"{}")
+        try:
+            blk = self.node.block(int(q["height"]))
+        except (KeyError, IndexError, ValueError) as e:
+            return json.dumps({"found": False, "log": str(e)}).encode()
+        h = blk.header
+        return json.dumps(
+            {
+                "found": True,
+                "height": h.height,
+                "time_ns": h.time_ns,
+                "chain_id": h.chain_id,
+                "app_version": h.app_version,
+                "data_root": h.data_hash.hex(),
+                "app_hash": h.app_hash.hex(),
+                "square_size": h.square_size,
+                "tx_hashes": [
+                    hashlib.sha256(t).hexdigest() for t in blk.txs
+                ],
+            }
+        ).encode()
+
+    def query(self, req: bytes, ctx) -> bytes:
+        q = json.loads(req or b"{}")
+        path = q.get("path", "")
+        data = q.get("data", {})
+        try:
+            result = self.node.abci_query(path, data)
+            return json.dumps({"code": 0, "value": result}, default=str).encode()
+        except Exception as e:
+            return json.dumps({"code": 1, "log": str(e)}).encode()
+
+    # -- grpc wiring ---------------------------------------------------
+
+    def handlers(self) -> grpc.GenericRpcHandler:
+        rpcs = {
+            "Broadcast": self.broadcast,
+            "GetTx": self.get_tx,
+            "AccountInfo": self.account_info,
+            "Simulate": self.simulate,
+            "Status": self.status,
+            "Block": self.block,
+            "Query": self.query,
+        }
+        method_handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=_identity, response_serializer=_identity
+            )
+            for name, fn in rpcs.items()
+        }
+        return grpc.method_handlers_generic_handler(SERVICE, method_handlers)
+
+
+class NodeServer:
+    """A running node + its gRPC service + a block-production loop."""
+
+    def __init__(
+        self,
+        node,
+        address: str = "127.0.0.1:0",
+        block_interval_s: Optional[float] = None,
+        max_workers: int = 8,
+    ):
+        self.node = node
+        self.service = NodeService(node)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self._server.add_generic_rpc_handlers((self.service.handlers(),))
+        self.port = self._server.add_insecure_port(address)
+        if self.port == 0:
+            raise RuntimeError(f"could not bind gRPC server to {address}")
+        host = address.rsplit(":", 1)[0]
+        self.address = f"{host}:{self.port}"
+        self.block_interval_s = block_interval_s
+        self._stop = threading.Event()
+        self._producer: Optional[threading.Thread] = None
+        # node-internal locking: the production loop and gRPC workers touch
+        # the same app state; the TestNode surface is synchronised by this
+        # coarse lock installed onto the node.
+        if not hasattr(node, "_service_lock"):
+            node._service_lock = threading.RLock()
+        self._wrap_node_with_lock()
+
+    def _wrap_node_with_lock(self) -> None:
+        lock = self.node._service_lock
+        for name in (
+            "broadcast_tx", "get_tx", "account_info", "simulate",
+            "produce_block", "block", "abci_query",
+        ):
+            fn = getattr(self.node, name, None)
+            if fn is None or getattr(fn, "_locked", False):
+                continue
+
+            def locked(*a, _fn=fn, **kw):
+                with lock:
+                    return _fn(*a, **kw)
+
+            locked._locked = True
+            setattr(self.node, name, locked)
+
+    def start(self) -> None:
+        self._server.start()
+        if self.block_interval_s:
+            self._producer = threading.Thread(
+                target=self._produce_loop, name="block-producer", daemon=True
+            )
+            self._producer.start()
+
+    def _produce_loop(self) -> None:
+        while not self._stop.wait(self.block_interval_s):
+            try:
+                self.node.produce_block()
+            except Exception:  # noqa: BLE001 — producer must survive
+                import traceback
+
+                traceback.print_exc()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._stop.set()
+        self._server.stop(grace)
+        if self._producer is not None:
+            self._producer.join(timeout=5)
+
+    def __enter__(self) -> "NodeServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

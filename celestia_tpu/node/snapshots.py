@@ -1,0 +1,166 @@
+"""State-sync snapshots: chunked export, restore, pruning.
+
+Parity role: the reference snapshots app state every 1500 blocks into a
+chunk store, keeps the 2 most recent, and restores joining nodes from them
+(cmd/celestia-appd/cmd/root.go:227-243 snapshot store wiring,
+app/default_overrides.go:296-297 interval/keep-recent defaults,
+``celestia-appd snapshot`` command root.go:158-160).
+
+Format: one directory per snapshot (``<height>-<format>``) holding
+``metadata.json`` (height, app hash, chain id, app version, chunk count +
+per-chunk sha256) and zlib-compressed chunk files of the JSON store dump.
+Every chunk is integrity-checked on restore; the restored state must
+reproduce the snapshot's recorded app hash or the restore is rejected.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import shutil
+import zlib
+from dataclasses import dataclass
+from pathlib import Path
+from typing import List, Optional
+
+SNAPSHOT_FORMAT = 1
+CHUNK_BYTES = 1 << 20
+
+
+@dataclass(frozen=True)
+class SnapshotInfo:
+    height: int
+    format: int
+    chunks: int
+    app_hash: bytes
+    chain_id: str
+    app_version: int
+
+    @property
+    def dirname(self) -> str:
+        return f"{self.height}-{self.format}"
+
+
+class SnapshotStore:
+    """File-backed snapshot store under one directory."""
+
+    def __init__(self, root: str):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    # -- creation ------------------------------------------------------
+
+    def create(self, app) -> SnapshotInfo:
+        """Snapshot the app's latest committed state."""
+        height = app.store.last_height
+        app_hash = app.store.committed_hash(height)
+        payload = zlib.compress(
+            json.dumps(
+                {"state": app.store.export(), "genesis_time_ns": app.genesis_time_ns}
+            ).encode(),
+            level=6,
+        )
+        chunks = [
+            payload[i : i + CHUNK_BYTES]
+            for i in range(0, max(len(payload), 1), CHUNK_BYTES)
+        ]
+        info = SnapshotInfo(
+            height=height,
+            format=SNAPSHOT_FORMAT,
+            chunks=len(chunks),
+            app_hash=app_hash,
+            chain_id=app.chain_id,
+            app_version=app.app_version,
+        )
+        tmp = self.root / (info.dirname + ".tmp")
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        tmp.mkdir()
+        chunk_hashes = []
+        for i, chunk in enumerate(chunks):
+            (tmp / f"chunk-{i:04d}").write_bytes(chunk)
+            chunk_hashes.append(hashlib.sha256(chunk).hexdigest())
+        (tmp / "metadata.json").write_text(
+            json.dumps(
+                {
+                    "height": info.height,
+                    "format": info.format,
+                    "chunks": info.chunks,
+                    "chunk_hashes": chunk_hashes,
+                    "app_hash": app_hash.hex(),
+                    "chain_id": info.chain_id,
+                    "app_version": info.app_version,
+                }
+            )
+        )
+        final = self.root / info.dirname
+        if final.exists():
+            shutil.rmtree(final)
+        tmp.rename(final)
+        return info
+
+    # -- listing / pruning ---------------------------------------------
+
+    def list(self) -> List[SnapshotInfo]:
+        out = []
+        for d in sorted(self.root.iterdir()):
+            meta = d / "metadata.json"
+            if not d.is_dir() or not meta.exists():
+                continue
+            m = json.loads(meta.read_text())
+            out.append(
+                SnapshotInfo(
+                    height=m["height"],
+                    format=m["format"],
+                    chunks=m["chunks"],
+                    app_hash=bytes.fromhex(m["app_hash"]),
+                    chain_id=m["chain_id"],
+                    app_version=m["app_version"],
+                )
+            )
+        return sorted(out, key=lambda s: s.height)
+
+    def prune(self, keep_recent: int) -> int:
+        snaps = self.list()
+        dropped = 0
+        for info in snaps[:-keep_recent] if keep_recent > 0 else []:
+            shutil.rmtree(self.root / info.dirname, ignore_errors=True)
+            dropped += 1
+        return dropped
+
+    # -- restore -------------------------------------------------------
+
+    def latest(self) -> Optional[SnapshotInfo]:
+        snaps = self.list()
+        return snaps[-1] if snaps else None
+
+    def load_state(self, info: SnapshotInfo) -> dict:
+        """Read + verify chunks; returns {"state":…, "genesis_time_ns":…}."""
+        d = self.root / info.dirname
+        meta = json.loads((d / "metadata.json").read_text())
+        payload = b""
+        for i in range(info.chunks):
+            chunk = (d / f"chunk-{i:04d}").read_bytes()
+            want = meta["chunk_hashes"][i]
+            got = hashlib.sha256(chunk).hexdigest()
+            if got != want:
+                raise ValueError(
+                    f"snapshot chunk {i} corrupt: sha256 {got} != {want}"
+                )
+            payload += chunk
+        return json.loads(zlib.decompress(payload))
+
+    def restore_app(self, info: SnapshotInfo, **app_kwargs):
+        """Build a fresh App from a snapshot; verifies the app hash."""
+        from celestia_tpu.state.app import App
+
+        data = self.load_state(info)
+        app = App.restore_from_snapshot(
+            chain_id=info.chain_id,
+            state=data["state"],
+            height=info.height,
+            expected_app_hash=info.app_hash,
+            genesis_time_ns=data.get("genesis_time_ns", 0),
+            **app_kwargs,
+        )
+        return app

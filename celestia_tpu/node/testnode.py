@@ -13,6 +13,7 @@ fails loudly) -> finalize + commit.
 from __future__ import annotations
 
 import hashlib
+import threading
 import time as _time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -63,12 +64,31 @@ class TestNode:
         genesis_time_ns: Optional[int] = None,
         block_interval_ns: int = GOAL_BLOCK_TIME_SECONDS * 10**9,
         auto_produce: bool = True,
+        genesis: Optional[dict] = None,
+        validator_key: Optional[PrivateKey] = None,
+        snapshot_dir: Optional[str] = None,
+        snapshot_interval: int = 0,
+        snapshot_keep_recent: int = 2,
+        app: Optional[App] = None,
         **app_kwargs,
     ):
-        self.app = App(chain_id=chain_id, **app_kwargs)
-        self.chain_id = chain_id
+        # One reentrant lock serialises every client-surface entry point:
+        # concurrent confirm-polls (get_tx auto-produce), broadcasts and
+        # the server's production loop all touch app/mempool/blocks state
+        # (pkg/user's Signer is explicitly multi-threaded against one node)
+        self._service_lock = threading.RLock()
+        restored = app is not None
+        self.app = app if restored else App(chain_id=chain_id, **app_kwargs)
+        self.chain_id = self.app.chain_id if restored else chain_id
         self.block_interval_ns = block_interval_ns
         self.auto_produce = auto_produce
+        self.snapshot_interval = snapshot_interval
+        self.snapshot_keep_recent = snapshot_keep_recent
+        self.snapshots = None
+        if snapshot_dir:
+            from celestia_tpu.node.snapshots import SnapshotStore
+
+            self.snapshots = SnapshotStore(snapshot_dir)
         max_bytes = (
             self.app.max_effective_square_size() ** 2
             * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
@@ -76,24 +96,45 @@ class TestNode:
         self.mempool = Mempool(max_tx_bytes=max_bytes)
         self.blocks: List[Block] = []
         self._tx_index: Dict[bytes, dict] = {}
-        genesis = {
-            "chain_id": chain_id,
-            "genesis_time_ns": genesis_time_ns or _time.time_ns(),
-            "accounts": [],
-            "validators": [],
-        }
-        self._validator_key = PrivateKey.from_seed(b"testnode-validator")
-        val_addr = self._validator_key.public_key().address()
-        genesis["accounts"].append(
-            {"address": val_addr.hex(), "balance": 1_000_000_000_000}
+        # recent-block EDS/DAH/layout cache: inclusion proofs are served
+        # from here without recomputing the extension (the role of
+        # pkg/inclusion's EDS subtree cache + pkg/proof query routes)
+        self._eds_cache: Dict[int, dict] = {}
+        self.eds_cache_blocks = 8
+        self._validator_key = validator_key or PrivateKey.from_seed(
+            b"testnode-validator"
         )
-        genesis["validators"].append(
-            {"address": val_addr.hex(), "self_delegation": 100_000_000_000}
-        )
-        for key, balance in funded_accounts or []:
+        if restored:
+            # state-sync restore: the app already carries committed state at
+            # its snapshot height; no InitChain
+            self._now_ns = genesis_time_ns or _time.time_ns()
+            return
+        if genesis is None:
+            genesis = {
+                "chain_id": chain_id,
+                "genesis_time_ns": genesis_time_ns or _time.time_ns(),
+                "accounts": [],
+                "validators": [],
+            }
+            val_addr = self._validator_key.public_key().address()
             genesis["accounts"].append(
-                {"address": key.public_key().address().hex(), "balance": balance}
+                {"address": val_addr.hex(), "balance": 1_000_000_000_000}
             )
+            genesis["validators"].append(
+                {"address": val_addr.hex(), "self_delegation": 100_000_000_000}
+            )
+            for key, balance in funded_accounts or []:
+                genesis["accounts"].append(
+                    {
+                        "address": key.public_key().address().hex(),
+                        "balance": balance,
+                    }
+                )
+        else:
+            genesis = dict(genesis)
+            genesis.setdefault("chain_id", chain_id)
+            if not genesis.get("genesis_time_ns"):
+                genesis["genesis_time_ns"] = genesis_time_ns or _time.time_ns()
         self.app.init_chain(genesis)
         self._now_ns = self.app.genesis_time_ns
 
@@ -103,14 +144,22 @@ class TestNode:
 
     @property
     def height(self) -> int:
-        return self.blocks[-1].header.height if self.blocks else 1
+        if self.blocks:
+            return self.blocks[-1].header.height
+        # restored-from-snapshot nodes resume at the snapshot height
+        return max(1, self.app.store.last_height)
 
     def account_info(self, address: bytes) -> Tuple[int, int]:
-        acc = self.app.accounts.get_or_create(address)
-        return acc.account_number, acc.sequence
+        with self._service_lock:
+            acc = self.app.accounts.peek(address)
+            return acc.account_number, acc.sequence
 
     def broadcast_tx(self, raw: bytes) -> SubmitResult:
         """BroadcastMode_SYNC parity: CheckTx, then admit to the mempool."""
+        with self._service_lock:
+            return self._broadcast_tx_locked(raw)
+
+    def _broadcast_tx_locked(self, raw: bytes) -> SubmitResult:
         res = self.app.check_tx(raw)
         tx_hash = hashlib.sha256(raw).digest()
         if res.code != 0:
@@ -121,6 +170,10 @@ class TestNode:
         return SubmitResult(0, "", tx_hash)
 
     def get_tx(self, tx_hash: bytes) -> Optional[dict]:
+        with self._service_lock:
+            return self._get_tx_locked(tx_hash)
+
+    def _get_tx_locked(self, tx_hash: bytes) -> Optional[dict]:
         info = self._tx_index.get(tx_hash)
         if info is None and self.auto_produce and len(self.mempool):
             # emulate chain progress for poll-confirm clients: a pending
@@ -132,6 +185,10 @@ class TestNode:
     def simulate(self, raw: bytes) -> int:
         """Gas estimation via simulated ante + 20% margin (signer.go
         EstimateGas shape)."""
+        with self._service_lock:
+            return self._simulate_locked(raw)
+
+    def _simulate_locked(self, raw: bytes) -> int:
         tx = unmarshal_tx(raw)
         branch = self.app.store.branch()
         ctx = AnteContext(
@@ -157,6 +214,10 @@ class TestNode:
 
     def produce_block(self) -> Block:
         """One consensus round: reap -> Prepare -> Process -> finalize."""
+        with self._service_lock:
+            return self._produce_block_locked()
+
+    def _produce_block_locked(self) -> Block:
         height = self.height + 1
         self._now_ns += self.block_interval_ns
         time_ns = self._now_ns
@@ -183,6 +244,15 @@ class TestNode:
         )
         block = Block(header, proposal.block_txs, results)
         self.blocks.append(block)
+        # retain the proposal's EDS + layout for proof queries (bounded)
+        self._eds_cache[height] = {
+            "eds": proposal.eds,
+            "dah": proposal.dah,
+            "square": proposal.square,
+            "wrappers": proposal.wrappers,
+        }
+        for h in [h for h in self._eds_cache if h <= height - self.eds_cache_blocks]:
+            del self._eds_cache[h]
         # index included txs + drop them from the mempool
         for raw, res in zip(proposal.block_txs, results):
             h = hashlib.sha256(raw).digest()
@@ -190,7 +260,45 @@ class TestNode:
             self.mempool.remove(h)
         # txs the proposer dropped stay pooled until their TTL expires
         self.mempool.evict_expired(height)
+        if (
+            self.snapshots is not None
+            and self.snapshot_interval > 0
+            and height % self.snapshot_interval == 0
+        ):
+            self.snapshots.create(self.app)
+            self.snapshots.prune(self.snapshot_keep_recent)
         return block
+
+    @classmethod
+    def from_snapshot(
+        cls,
+        snapshot_dir: str,
+        block_interval_ns: int = GOAL_BLOCK_TIME_SECONDS * 10**9,
+        auto_produce: bool = True,
+        snapshot_interval: int = 0,
+        snapshot_keep_recent: int = 2,
+        validator_key: Optional[PrivateKey] = None,
+        **app_kwargs,
+    ) -> "TestNode":
+        """Boot a node from the latest state-sync snapshot (the restart
+        path of the reference's snapshot subsystem).  Snapshotting keeps
+        running at the given interval after restore."""
+        from celestia_tpu.node.snapshots import SnapshotStore
+
+        store = SnapshotStore(snapshot_dir)
+        info = store.latest()
+        if info is None:
+            raise FileNotFoundError(f"no snapshots in {snapshot_dir}")
+        app = store.restore_app(info, **app_kwargs)
+        return cls(
+            app=app,
+            block_interval_ns=block_interval_ns,
+            auto_produce=auto_produce,
+            snapshot_dir=snapshot_dir,
+            snapshot_interval=snapshot_interval,
+            snapshot_keep_recent=snapshot_keep_recent,
+            validator_key=validator_key,
+        )
 
     def produce_blocks(self, n: int) -> List[Block]:
         return [self.produce_block() for _ in range(n)]
@@ -208,6 +316,85 @@ class TestNode:
             if b.header.height == height:
                 return b
         raise KeyError(f"no block at height {height}")
+
+    def _block_artifacts(self, height: int) -> dict:
+        """EDS/DAH/layout for a block: cache hit, or reconstruct from txs
+        (older blocks fall out of the bounded cache but stay provable)."""
+        art = self._eds_cache.get(height)
+        if art is not None:
+            return art
+        from celestia_tpu.da import dah as dah_mod
+        from celestia_tpu.da.square import construct as construct_square
+
+        blk = self.block(height)
+        # the bound in effect when the block was built is its own recorded
+        # square size — the CURRENT gov bound may have changed since
+        square, _txs, wrappers = construct_square(
+            blk.txs, blk.header.square_size
+        )
+        eds, dah = dah_mod.extend_block(square)
+        if dah.hash != blk.header.data_hash:
+            raise RuntimeError(
+                f"reconstructed data root mismatch at height {height}"
+            )
+        art = {"eds": eds, "dah": dah, "square": square, "wrappers": wrappers}
+        self._eds_cache[height] = art
+        return art
+
+    def abci_query(self, path: str, data: dict):
+        """ABCI-style query routes (JSON-safe result values).
+
+        Parity targets: the proof query routes registered at
+        app/app.go:622-623 (pkg/proof/querier.go:28,72), plus the
+        bank/auth/params gRPC queries the reference serves via module
+        queriers (app/app.go:826-852).
+        """
+        from celestia_tpu.da import proof as proof_mod
+        from celestia_tpu.da.blob import unmarshal_blob_tx as _ubt
+
+        if path == "store/bank/balance":
+            return self.app.bank.balance(bytes.fromhex(data["address"]))
+        if path == "custom/auth/account":
+            acc = self.app.accounts.peek(bytes.fromhex(data["address"]))
+            return {
+                "account_number": acc.account_number,
+                "sequence": acc.sequence,
+                "pubkey": acc.pubkey.hex() if acc.pubkey else "",
+            }
+        if path == "custom/params/param":
+            return self.app.params.get(data["subspace"], data["key"])
+        if path == "custom/upgrade/status":
+            tally = self.app.upgrade.tally_voting_power(self.app.app_version + 1)
+            return {
+                "app_version": self.app.app_version,
+                "next_version_power": tally[0],
+                "total_power": tally[1],
+            }
+        if path == "custom/proof/share":
+            height = int(data["height"])
+            art = self._block_artifacts(height)
+            proof = proof_mod.new_share_inclusion_proof(
+                art["eds"], art["dah"], int(data["start"]), int(data["end"])
+            )
+            return {
+                "proof": proof.to_dict(),
+                "data_root": self.data_root(height).hex(),
+            }
+        if path == "custom/proof/tx":
+            height = int(data["height"])
+            art = self._block_artifacts(height)
+            blk = self.block(height)
+            normal = [t for t in blk.txs if _ubt(t) is None]
+            wrapped = [w.marshal() for w in art["wrappers"]]
+            proof = proof_mod.new_tx_inclusion_proof(
+                art["square"], art["eds"], art["dah"], normal, wrapped,
+                int(data["tx_index"]),
+            )
+            return {
+                "proof": proof.to_dict(),
+                "data_root": self.data_root(height).hex(),
+            }
+        raise ValueError(f"unknown query path: {path}")
 
     def data_root(self, height: int) -> bytes:
         return self.block(height).header.data_hash

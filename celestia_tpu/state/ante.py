@@ -72,6 +72,9 @@ class AnteContext:
     is_recheck: bool = False
     min_gas_price: float = 0.0  # node-local (CheckTx only)
     simulate: bool = False
+    # batch pre-verification result (threaded native secp256k1 over the
+    # whole proposal at once); None = verify inline
+    sig_ok: Optional[bool] = None
 
     def __post_init__(self):
         if self.gas_meter is None:
@@ -170,7 +173,10 @@ def verify_signature(ctx: AnteContext) -> None:
             f"account sequence mismatch, expected {acc.sequence}, got {tx.sequence}: "
             f"incorrect account sequence"
         )
-    if not tx.verify_signature(ctx.chain_id):
+    sig_ok = ctx.sig_ok
+    if sig_ok is None:
+        sig_ok = tx.verify_signature(ctx.chain_id)
+    if not sig_ok:
         raise AnteError("signature verification failed")
     if not acc.pubkey:
         acc.pubkey = tx.pubkey

@@ -47,15 +47,18 @@ from celestia_tpu.state.tx import (
     MsgRegisterEVMAddress,
     MsgSend,
     MsgSignalVersion,
+    MsgSubmitProposal,
     MsgTryUpgrade,
     MsgUndelegate,
+    MsgVote,
     Tx,
     unmarshal_tx,
 )
 from celestia_tpu.utils.telemetry import Telemetry
 
 STORE_NAMES = [
-    "auth", "bank", "staking", "params", "blob", "upgrade", "blobstream", "mint", "meta",
+    "auth", "bank", "staking", "params", "blob", "upgrade", "blobstream",
+    "mint", "gov", "meta",
 ]
 
 _APP_VERSION_KEY = b"app_version"
@@ -77,6 +80,10 @@ class PreparedProposal:
     data_root: bytes
     eds: "dah_mod.ExtendedDataSquare"
     dah: "dah_mod.DataAvailabilityHeader"
+    # retained layout artifacts so the node can serve inclusion proofs from
+    # the cached EDS without recompute (pkg/inclusion / proof querier role)
+    square: Optional[object] = None
+    wrappers: Optional[List[object]] = None
 
 
 class App:
@@ -95,6 +102,7 @@ class App:
         self._wire_keepers()
         self.telemetry = Telemetry()
         self.block_time_ns = 0
+        self.block_height = 0
         self.genesis_time_ns = 0
         # persistent CheckTx state, branched from committed state and reset
         # on every commit (baseapp checkState parity) — lets several pending
@@ -113,6 +121,19 @@ class App:
         )
         self.mint = MintKeeper(self.store.store("mint"), self.bank)
         self.param_block_list = ParamBlockList()
+        from celestia_tpu.state.modules.gov import GovKeeper
+
+        self.gov = GovKeeper(
+            self.store.store("gov"), self.bank, self.staking, self.params,
+            self.param_block_list,
+        )
+        # IBC transfer stack with the token filter mounted (app.go:71-78);
+        # channel handshakes are operator-driven (ibc.open_channel)
+        from celestia_tpu.state.modules.ibc import IBCStack
+
+        self.ibc = IBCStack(
+            name=self.chain_id, bank=self.bank, filtered=True
+        )
 
     # ------------------------------------------------------------------
     # version / sizing
@@ -223,6 +244,44 @@ class App:
     # PrepareProposal — prepare_proposal.go:23-96
     # ------------------------------------------------------------------
 
+    def _decode_proposal_txs(self, txs: List[bytes]):
+        """Decode every proposal tx, then batch-verify all signatures in one
+        threaded native secp256k1 pass (the per-tx EC multiplication is the
+        dominant host cost of FilterTxs/ProcessProposal — the reference
+        leans on C secp256k1 for the same reason, SURVEY.md §2.2).
+
+        Yields (raw, tx, raw_inner, sig_ok, decode_error) per input tx.
+        """
+        from celestia_tpu.utils.secp256k1 import verify_batch
+
+        decoded: List[tuple] = []
+        for raw in txs:
+            btx = unmarshal_blob_tx(raw)
+            try:
+                if btx is not None:
+                    # full BlobTx validation incl. commitment recompute
+                    tx = validate_blob_tx(btx, self.chain_id)
+                    raw_inner = btx.tx
+                else:
+                    tx = unmarshal_tx(raw)
+                    if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs):
+                        raise AnteError("PFB without blobs")
+                    raw_inner = raw
+                decoded.append((raw, tx, raw_inner, None))
+            except (AnteError, ValueError) as e:
+                decoded.append((raw, None, None, e))
+        live = [d for d in decoded if d[1] is not None]
+        sig_results = verify_batch(
+            [tx.sign_bytes(self.chain_id) for _, tx, _, _ in live],
+            [tx.signature for _, tx, _, _ in live],
+            [tx.pubkey for _, tx, _, _ in live],
+        )
+        ok_iter = iter(sig_results)
+        return [
+            (raw, tx, raw_inner, next(ok_iter) if tx is not None else False, err)
+            for raw, tx, raw_inner, err in decoded
+        ]
+
     def _filter_txs(self, txs: List[bytes]) -> List[bytes]:
         """FilterTxs parity (validate_txs.go:29-97): run the ante chain over
         each tx on one branched state, in priority order; drop failures."""
@@ -231,17 +290,11 @@ class App:
         bank = BankKeeper(branch.store("bank"))
         params = ParamsKeeper(branch.store("params"))
         kept: List[bytes] = []
-        for raw in txs:
-            btx = unmarshal_blob_tx(raw)
+        for raw, tx, raw_inner, sig_ok, err in self._decode_proposal_txs(txs):
+            if err is not None:
+                self.telemetry.incr("prepare_proposal_dropped_tx")
+                continue
             try:
-                if btx is not None:
-                    tx = validate_blob_tx(btx, self.chain_id)
-                    raw_inner = btx.tx
-                else:
-                    tx = unmarshal_tx(raw)
-                    if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs):
-                        raise AnteError("PFB without blobs")
-                    raw_inner = raw
                 ctx = AnteContext(
                     tx=tx,
                     raw_tx=raw_inner,
@@ -250,6 +303,7 @@ class App:
                     params=params,
                     chain_id=self.chain_id,
                     app_version=self.app_version,
+                    sig_ok=sig_ok,
                 )
                 run_ante(ctx)
                 kept.append(raw)
@@ -262,7 +316,7 @@ class App:
         t0 = _time.time()
         try:
             kept = self._filter_txs(txs)
-            square, block_txs, _wrappers = build_square(
+            square, block_txs, wrappers = build_square(
                 kept, self.max_effective_square_size()
             )
             eds, dah = dah_mod.extend_block(square)
@@ -272,6 +326,8 @@ class App:
                 data_root=dah.hash,
                 eds=eds,
                 dah=dah,
+                square=square,
+                wrappers=wrappers,
             )
         finally:
             self.telemetry.measure_since("prepare_proposal", t0)
@@ -291,17 +347,11 @@ class App:
             accounts = AccountKeeper(branch.store("auth"))
             bank = BankKeeper(branch.store("bank"))
             params = ParamsKeeper(branch.store("params"))
-            for raw in block_txs:
-                btx = unmarshal_blob_tx(raw)
-                if btx is not None:
-                    # full BlobTx re-validation incl. commitment recompute
-                    tx = validate_blob_tx(btx, self.chain_id)
-                    raw_inner = btx.tx
-                else:
-                    tx = unmarshal_tx(raw)
-                    if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs):
-                        return False, "PFB without blobs in proposal"
-                    raw_inner = raw
+            for raw, tx, raw_inner, sig_ok, err in self._decode_proposal_txs(
+                block_txs
+            ):
+                if err is not None:
+                    return False, f"invalid tx in proposal: {err}"
                 ctx = AnteContext(
                     tx=tx,
                     raw_tx=raw_inner,
@@ -310,6 +360,7 @@ class App:
                     params=params,
                     chain_id=self.chain_id,
                     app_version=self.app_version,
+                    sig_ok=sig_ok,
                 )
                 run_ante(ctx)
             # strict reconstruction
@@ -341,6 +392,7 @@ class App:
 
     def begin_block(self, height: int, time_ns: int) -> None:
         self.block_time_ns = time_ns
+        self.block_height = height
         self.mint.begin_blocker(time_ns)
 
     def deliver_tx(self, raw: bytes) -> TxResult:
@@ -419,12 +471,19 @@ class App:
 
             self.params.set(msg.subspace, msg.key, _json.loads(msg.value))
             return {"type": "param_change", "key": f"{msg.subspace}/{msg.key}"}
+        if isinstance(msg, MsgSubmitProposal):
+            pid = self.gov.submit_proposal(msg, self.block_height)
+            return {"type": "submit_proposal", "proposal_id": pid}
+        if isinstance(msg, MsgVote):
+            self.gov.vote(msg, self.block_height)
+            return {"type": "vote", "proposal_id": msg.proposal_id}
         raise ValueError(f"no handler for message {type(msg).__name__}")
 
     def end_block(self, height: int, time_ns: int) -> dict:
         """EndBlocker parity (app.go:675-708): module end-blockers, then
         upgrade consumption (v1 height-based or v2 signal-based)."""
         attestations = self.blobstream.end_blocker(height, time_ns)
+        gov_events = self.gov.end_blocker(height, self)
         upgraded_to = None
         if self.app_version == 1 and self.v2_upgrade_height is not None:
             if height == self.v2_upgrade_height - 1:
@@ -444,8 +503,13 @@ class App:
             self._set_app_version(upgraded_to)
             self.upgrade.consume_upgrade()
             self.telemetry.incr("upgrades")
-            return {"attestations": attestations, "upgraded_to": upgraded_to, "migrations": log}
-        return {"attestations": attestations}
+            return {
+                "attestations": attestations,
+                "gov": gov_events,
+                "upgraded_to": upgraded_to,
+                "migrations": log,
+            }
+        return {"attestations": attestations, "gov": gov_events}
 
     def finalize_block(
         self,
@@ -495,3 +559,31 @@ class App:
         """Roll back to a committed height (app.go:729 LoadHeight)."""
         self.store.load_height(height)
         self._wire_keepers()
+
+    @classmethod
+    def restore_from_snapshot(
+        cls,
+        chain_id: str,
+        state: dict,
+        height: int,
+        expected_app_hash: bytes,
+        genesis_time_ns: int = 0,
+        **kwargs,
+    ) -> "App":
+        """Rebuild an App from a state-sync snapshot (the restore half of
+        the reference's snapshot subsystem, root.go:227-243).  The restored
+        multistore must reproduce the snapshot's recorded app hash."""
+        app = cls(chain_id=chain_id, **kwargs)
+        app.store = MultiStore.import_state(state)
+        for name in STORE_NAMES:
+            app.store.ensure_store(name)
+        app._wire_keepers()
+        app.genesis_time_ns = genesis_time_ns
+        got = app.store.app_hash()
+        if got != expected_app_hash:
+            raise ValueError(
+                f"snapshot restore hash mismatch: state hashes to "
+                f"{got.hex()}, snapshot recorded {expected_app_hash.hex()}"
+            )
+        app.store.commit_at(height, got)
+        return app

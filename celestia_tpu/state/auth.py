@@ -54,6 +54,19 @@ class AccountKeeper:
     def set(self, acc: Account) -> None:
         self.store.set(_ACCOUNT_PREFIX + acc.address, acc.marshal())
 
+    def peek(self, address: bytes) -> "Account":
+        """Non-mutating read for query paths: the existing account, or the
+        account AS IT WOULD BE CREATED (next global number, sequence 0)
+        without writing anything.  Queries must never touch consensus
+        state — a query-created account would fork the app hash between
+        nodes that did and didn't serve it."""
+        acc = self.get(address)
+        if acc is not None:
+            return acc
+        num_raw = self.store.get(_GLOBAL_NUM_KEY)
+        num = int.from_bytes(num_raw, "big") if num_raw else 0
+        return Account(address, b"", num, 0)
+
     def get_or_create(self, address: bytes) -> Account:
         acc = self.get(address)
         if acc is None:

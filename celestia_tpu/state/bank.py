@@ -75,3 +75,63 @@ class BankKeeper:
             k[len(_BALANCE_PREFIX):]: int.from_bytes(v, "big")
             for k, v in self.store.iterate(_BALANCE_PREFIX)
         }
+
+    # -- multi-denom (IBC vouchers) ------------------------------------
+    #
+    # The native denom rides the fast single-denom path above; other denoms
+    # (ICS-20 voucher denoms on counterparty chains in tests — Celestia
+    # itself never mints one thanks to x/tokenfilter) are stored under
+    # denom-scoped keys.
+
+    NATIVE_DENOM = "utia"
+
+    def balance_of(self, addr: bytes, denom: str) -> int:
+        if denom == self.NATIVE_DENOM:
+            return self.balance(addr)
+        raw = self.store.get(b"bal2/" + denom.encode() + b"/" + addr)
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def _set_balance_of(self, addr: bytes, denom: str, amount: int) -> None:
+        if denom == self.NATIVE_DENOM:
+            self._set_balance(addr, amount)
+            return
+        if amount < 0:
+            raise ValueError("negative balance")
+        key = b"bal2/" + denom.encode() + b"/" + addr
+        if amount == 0:
+            self.store.delete(key)
+        else:
+            self.store.set(key, amount.to_bytes(16, "big"))
+
+    def send_denom(
+        self, from_addr: bytes, to_addr: bytes, amount: int, denom: str
+    ) -> None:
+        if denom == self.NATIVE_DENOM:
+            self.send(from_addr, to_addr, amount)
+            return
+        bal = self.balance_of(from_addr, denom)
+        if amount < 0 or bal < amount:
+            raise ValueError(
+                f"insufficient funds: balance {bal}{denom} < {amount}{denom}"
+            )
+        self._set_balance_of(from_addr, denom, bal - amount)
+        self._set_balance_of(
+            to_addr, denom, self.balance_of(to_addr, denom) + amount
+        )
+
+    def mint_denom(self, to_addr: bytes, amount: int, denom: str) -> None:
+        if denom == self.NATIVE_DENOM:
+            self.mint(to_addr, amount)
+            return
+        self._set_balance_of(
+            to_addr, denom, self.balance_of(to_addr, denom) + amount
+        )
+
+    def burn_denom(self, from_addr: bytes, amount: int, denom: str) -> None:
+        if denom == self.NATIVE_DENOM:
+            self.burn(from_addr, amount)
+            return
+        bal = self.balance_of(from_addr, denom)
+        if bal < amount:
+            raise ValueError("insufficient funds to burn")
+        self._set_balance_of(from_addr, denom, bal - amount)

@@ -1,0 +1,233 @@
+"""Minimal IBC transfer stack: channels, ICS-20 app module, middleware.
+
+Role: the transport x/tokenfilter mounts on.  The reference wires its
+middleware into ibc-go's transfer stack (app/app.go:71-78,
+x/tokenfilter/ibc_middleware.go:38-80); here the same three layers exist
+natively:
+
+  ChannelKeeper  — channel registry, send/recv sequences, packet
+                   commitments and acknowledgements (ICS-4 surface).
+  TransferModule — ICS-20 escrow/mint semantics: native tokens escrow on
+                   send and unescrow on return; foreign tokens would mint
+                   prefixed vouchers on receive (on Celestia the token
+                   filter forbids that branch); error acks refund.
+  middleware     — any wrapper implementing on_recv_packet; the token
+                   filter middleware rejects foreign tokens with an error
+                   acknowledgement BEFORE the transfer module can mint.
+
+An in-process Relayer connects two stacks for tests (the shape of ibc-go's
+testing chains used by the reference's test/tokenfilter suite).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from celestia_tpu.state.modules.tokenfilter import (
+    Acknowledgement,
+    FungibleTokenPacketData,
+    NATIVE_DENOM,
+    Packet,
+    on_recv_packet as tokenfilter_policy,
+)
+
+TRANSFER_PORT = "transfer"
+
+
+def escrow_address(port: str, channel: str) -> bytes:
+    """Deterministic per-channel escrow account (ics20 escrow address)."""
+    return hashlib.sha256(f"ics20-escrow/{port}/{channel}".encode()).digest()[:20]
+
+
+@dataclass
+class Channel:
+    channel_id: str
+    port: str
+    counterparty_channel: str
+    counterparty_port: str
+    state: str = "OPEN"
+
+
+class ChannelKeeper:
+    """ICS-4 surface: channels, sequences, commitments, acks."""
+
+    def __init__(self):
+        self.channels: Dict[str, Channel] = {}
+        self._next_seq: Dict[str, int] = {}
+        self.commitments: Dict[Tuple[str, int], bytes] = {}
+        self.acks: Dict[Tuple[str, int], Acknowledgement] = {}
+
+    def open_channel(
+        self, channel_id: str, counterparty_channel: str,
+        port: str = TRANSFER_PORT, counterparty_port: str = TRANSFER_PORT,
+    ) -> Channel:
+        ch = Channel(channel_id, port, counterparty_channel, counterparty_port)
+        self.channels[channel_id] = ch
+        self._next_seq[channel_id] = 1
+        return ch
+
+    def send_packet(self, channel_id: str, data: bytes) -> Tuple[Packet, int]:
+        ch = self.channels.get(channel_id)
+        if ch is None or ch.state != "OPEN":
+            raise ValueError(f"channel {channel_id} is not open")
+        seq = self._next_seq[channel_id]
+        self._next_seq[channel_id] = seq + 1
+        packet = Packet(
+            source_port=ch.port,
+            source_channel=ch.channel_id,
+            dest_port=ch.counterparty_port,
+            dest_channel=ch.counterparty_channel,
+            data=data,
+        )
+        self.commitments[(channel_id, seq)] = hashlib.sha256(data).digest()
+        return packet, seq
+
+    def write_ack(self, channel_id: str, seq: int, ack: Acknowledgement) -> None:
+        self.acks[(channel_id, seq)] = ack
+
+    def delete_commitment(self, channel_id: str, seq: int) -> None:
+        self.commitments.pop((channel_id, seq), None)
+
+
+class TransferModule:
+    """ICS-20 application module over a denom-aware bank."""
+
+    def __init__(self, bank, channels: ChannelKeeper, chain_name: str = "chain"):
+        self.bank = bank
+        self.channels = channels
+        self.chain_name = chain_name
+
+    # -- send side -----------------------------------------------------
+
+    def send_transfer(
+        self,
+        sender: bytes,
+        receiver: str,
+        amount: int,
+        denom: str,
+        channel_id: str,
+    ) -> Tuple[Packet, int]:
+        ch = self.channels.channels.get(channel_id)
+        if ch is None:
+            raise ValueError(f"unknown channel {channel_id}")
+        prefix = f"{ch.port}/{ch.channel_id}/"
+        if denom.startswith(prefix):
+            # voucher going home: burn it here (the counterparty unescrows)
+            self.bank.burn_denom(sender, amount, denom)
+        else:
+            # source-chain token: escrow it
+            self.bank.send_denom(
+                sender, escrow_address(ch.port, ch.channel_id), amount, denom
+            )
+        data = FungibleTokenPacketData(
+            denom=denom,
+            amount=str(amount),
+            sender=sender.hex(),
+            receiver=receiver,
+        ).to_json()
+        return self.channels.send_packet(channel_id, data)
+
+    # -- receive side --------------------------------------------------
+
+    def on_recv_packet(self, packet: Packet) -> Acknowledgement:
+        try:
+            data = FungibleTokenPacketData.from_json(packet.data)
+            amount = int(data.amount)
+            receiver = bytes.fromhex(data.receiver)
+        except (ValueError, KeyError):
+            return Acknowledgement(False, "cannot unmarshal ICS-20 packet data")
+        prefix = f"{packet.source_port}/{packet.source_channel}/"
+        try:
+            if data.denom.startswith(prefix):
+                # token returning to its source: unescrow the original
+                base = data.denom[len(prefix):]
+                self.bank.send_denom(
+                    escrow_address(packet.dest_port, packet.dest_channel),
+                    receiver, amount, base,
+                )
+            else:
+                # foreign token: mint a voucher with this hop's prefix
+                voucher = (
+                    f"{packet.dest_port}/{packet.dest_channel}/{data.denom}"
+                )
+                self.bank.mint_denom(receiver, amount, voucher)
+        except ValueError as e:
+            return Acknowledgement(False, str(e))
+        return Acknowledgement(True)
+
+    # -- ack / refund --------------------------------------------------
+
+    def on_acknowledgement(
+        self, packet: Packet, seq: int, ack: Acknowledgement
+    ) -> None:
+        self.channels.delete_commitment(packet.source_channel, seq)
+        if ack.success:
+            return
+        # refund: reverse the send-side escrow/burn
+        try:
+            data = FungibleTokenPacketData.from_json(packet.data)
+        except (ValueError, KeyError):
+            return
+        sender = bytes.fromhex(data.sender)
+        amount = int(data.amount)
+        prefix = f"{packet.source_port}/{packet.source_channel}/"
+        if data.denom.startswith(prefix):
+            self.bank.mint_denom(sender, amount, data.denom)  # re-mint voucher
+        else:
+            self.bank.send_denom(
+                escrow_address(packet.source_port, packet.source_channel),
+                sender, amount, data.denom,
+            )
+
+
+class TokenFilterMiddleware:
+    """tokenFilterMiddleware parity (ibc_middleware.go:38-80): wraps an IBC
+    app module; foreign tokens get an error acknowledgement and NEVER reach
+    the wrapped module's mint path."""
+
+    def __init__(self, app_module: TransferModule):
+        self.app = app_module
+
+    def on_recv_packet(self, packet: Packet) -> Acknowledgement:
+        verdict = tokenfilter_policy(packet)
+        if not verdict.success:
+            return verdict
+        return self.app.on_recv_packet(packet)
+
+    def __getattr__(self, name):
+        return getattr(self.app, name)
+
+
+@dataclass
+class IBCStack:
+    """One chain's transfer stack: channels + (possibly wrapped) module."""
+
+    name: str
+    bank: object
+    channels: ChannelKeeper = field(default_factory=ChannelKeeper)
+    filtered: bool = False
+
+    def __post_init__(self):
+        module = TransferModule(self.bank, self.channels, self.name)
+        self.module = TokenFilterMiddleware(module) if self.filtered else module
+
+
+class Relayer:
+    """In-process packet relay between two stacks (ibc-go testing shape)."""
+
+    def __init__(self, a: IBCStack, b: IBCStack,
+                 channel_a: str = "channel-0", channel_b: str = "channel-0"):
+        self.a, self.b = a, b
+        self.channel_a, self.channel_b = channel_a, channel_b
+        a.channels.open_channel(channel_a, channel_b)
+        b.channels.open_channel(channel_b, channel_a)
+
+    def relay(self, src: IBCStack, packet: Packet, seq: int) -> Acknowledgement:
+        dst = self.b if src is self.a else self.a
+        ack = dst.module.on_recv_packet(packet)
+        dst.channels.write_ack(packet.dest_channel, seq, ack)
+        src.module.on_acknowledgement(packet, seq, ack)
+        return ack

@@ -186,6 +186,15 @@ class MultiStore:
     def last_height(self) -> int:
         return self._last_height
 
+    def commit_at(self, height: int, app_hash: bytes) -> None:
+        """Record the current state as the committed version at ``height``
+        (snapshot restore: the store resumes as if it had committed there)."""
+        if self._parent is not None:
+            raise ValueError("cannot commit a branched store")
+        snapshot = {n: dict(self._flatten(n)) for n in self._layers}
+        self._versions.append((height, snapshot, app_hash))
+        self._last_height = height
+
     def prune(self, keep_recent: int) -> None:
         if keep_recent > 0 and len(self._versions) > keep_recent:
             self._versions = self._versions[-keep_recent:]

@@ -151,6 +151,44 @@ class MsgParamChange:
         return [self.authority]
 
 
+@dataclass(frozen=True)
+class MsgSubmitProposal:
+    """Submit a governance proposal carrying param changes (x/gov submit +
+    ParamChangeProposal content; executed through the blocklist-gated
+    handler, x/paramfilter/gov_handler.go:36-60)."""
+
+    proposer: bytes
+    title: str
+    description: str
+    # each change: (subspace, key, json-encoded value)
+    changes: Tuple[Tuple[str, str, bytes], ...]
+    deposit: int
+
+    TYPE = 9
+
+    def signers(self) -> List[bytes]:
+        return [self.proposer]
+
+
+@dataclass(frozen=True)
+class MsgVote:
+    """Vote on an active governance proposal (x/gov vote)."""
+
+    voter: bytes
+    proposal_id: int
+    option: int  # 1 = yes, 2 = no, 3 = abstain, 4 = no-with-veto
+
+    TYPE = 10
+
+    OPTION_YES = 1
+    OPTION_NO = 2
+    OPTION_ABSTAIN = 3
+    OPTION_VETO = 4
+
+    def signers(self) -> List[bytes]:
+        return [self.voter]
+
+
 Msg = Union[
     MsgSend,
     MsgPayForBlobs,
@@ -160,6 +198,8 @@ Msg = Union[
     MsgDelegate,
     MsgUndelegate,
     MsgParamChange,
+    MsgSubmitProposal,
+    MsgVote,
 ]
 
 _MSG_TYPES = {
@@ -173,6 +213,8 @@ _MSG_TYPES = {
         MsgDelegate,
         MsgUndelegate,
         MsgParamChange,
+        MsgSubmitProposal,
+        MsgVote,
     )
 }
 
@@ -211,6 +253,20 @@ def marshal_msg(msg: Msg) -> bytes:
         _put_bytes(out, msg.subspace.encode())
         _put_bytes(out, msg.key.encode())
         _put_bytes(out, msg.value)
+    elif isinstance(msg, MsgSubmitProposal):
+        _put_bytes(out, msg.proposer)
+        _put_bytes(out, msg.title.encode())
+        _put_bytes(out, msg.description.encode())
+        out += _varint(len(msg.changes))
+        for sub, key, val in msg.changes:
+            _put_bytes(out, sub.encode())
+            _put_bytes(out, key.encode())
+            _put_bytes(out, val)
+        out += _varint(msg.deposit)
+    elif isinstance(msg, MsgVote):
+        _put_bytes(out, msg.voter)
+        out += _varint(msg.proposal_id)
+        out += _varint(msg.option)
     else:
         raise TypeError(f"unknown msg type {type(msg)}")
     return bytes(out)
@@ -265,6 +321,29 @@ def unmarshal_msg(raw: bytes, pos: int = 0) -> Tuple[Msg, int]:
         key, pos = _get_bytes(raw, pos)
         val, pos = _get_bytes(raw, pos)
         return MsgParamChange(auth, sub.decode(), key.decode(), val), pos
+    if t == MsgSubmitProposal.TYPE:
+        proposer, pos = _get_bytes(raw, pos)
+        title, pos = _get_bytes(raw, pos)
+        desc, pos = _get_bytes(raw, pos)
+        n, pos = _read_varint(raw, pos)
+        changes = []
+        for _ in range(n):
+            sub, pos = _get_bytes(raw, pos)
+            key, pos = _get_bytes(raw, pos)
+            val, pos = _get_bytes(raw, pos)
+            changes.append((sub.decode(), key.decode(), val))
+        deposit, pos = _read_varint(raw, pos)
+        return (
+            MsgSubmitProposal(
+                proposer, title.decode(), desc.decode(), tuple(changes), deposit
+            ),
+            pos,
+        )
+    if t == MsgVote.TYPE:
+        voter, pos = _get_bytes(raw, pos)
+        pid, pos = _read_varint(raw, pos)
+        opt, pos = _read_varint(raw, pos)
+        return MsgVote(voter, pid, opt), pos
     raise ValueError(f"unknown msg type id {t}")
 
 

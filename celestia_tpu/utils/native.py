@@ -28,7 +28,7 @@ def _build() -> bool:
     try:
         subprocess.run(
             [
-                "g++", "-O3", "-march=native", "-shared", "-fPIC",
+                "g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
                 str(_SRC), "-o", str(_SO),
             ],
             check=True,
@@ -59,6 +59,14 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.sha256_batch.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
     lib.nmt_root.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
     lib.eds_nmt_roots.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
+    lib.extend_block_cpu.argtypes = [
+        u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p, u8p, u8p,
+    ]
+    lib.secp256k1_ecmul_double.argtypes = [u8p, u8p, u8p, u8p, u8p]
+    lib.secp256k1_ecmul_double.restype = ctypes.c_int
+    lib.secp256k1_ecmul_double_batch.argtypes = [
+        u8p, u8p, u8p, ctypes.c_int, u8p, u8p, ctypes.c_int,
+    ]
     _lib = lib
     return _lib
 
@@ -108,3 +116,73 @@ def eds_nmt_roots(eds: np.ndarray) -> np.ndarray:
     out = np.zeros((2 * n, 90), dtype=np.uint8)
     lib.eds_nmt_roots(_ptr(eds), k, eds.shape[2], _ptr(out))
     return out
+
+
+def extend_block_cpu(square: np.ndarray, nthreads: int = 0):
+    """Full CPU ExtendBlock: square -> (eds, axis roots, data root).
+
+    Threaded native pipeline — the honest CPU comparison leg for bench.py
+    (role of Leopard-RS + crypto/sha256 in the reference, SURVEY.md §2.2).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    from celestia_tpu.ops.gf256 import encode_matrix
+
+    square = np.ascontiguousarray(square, dtype=np.uint8)
+    k, B = square.shape[0], square.shape[2]
+    E = np.ascontiguousarray(encode_matrix(k))
+    eds = np.zeros((2 * k, 2 * k, B), dtype=np.uint8)
+    roots = np.zeros((4 * k, 90), dtype=np.uint8)
+    data_root = np.zeros(32, dtype=np.uint8)
+    lib.extend_block_cpu(
+        _ptr(square), _ptr(E), k, B, nthreads, _ptr(eds), _ptr(roots),
+        _ptr(data_root),
+    )
+    return eds, roots, data_root
+
+
+def ecmul_double(u1_be: bytes, u2_be: bytes, pub33: bytes):
+    """(u1*G + u2*Q) affine coords, or None on infinity/invalid pubkey.
+
+    The expensive inner op of ECDSA verification (reference relies on the
+    decred C secp256k1 for this — SURVEY.md §2.2); scalar math mod the group
+    order stays in Python where CPython's pow() is already C.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    u1 = np.frombuffer(u1_be, dtype=np.uint8)
+    u2 = np.frombuffer(u2_be, dtype=np.uint8)
+    pub = np.frombuffer(pub33, dtype=np.uint8)
+    out_x = np.zeros(32, dtype=np.uint8)
+    out_y = np.zeros(32, dtype=np.uint8)
+    ok = lib.secp256k1_ecmul_double(
+        _ptr(u1), _ptr(u2), _ptr(pub), _ptr(out_x), _ptr(out_y)
+    )
+    if not ok:
+        return None
+    return out_x.tobytes(), out_y.tobytes()
+
+
+def ecmul_double_batch(
+    u1s: np.ndarray, u2s: np.ndarray, pubs: np.ndarray, nthreads: int = 0
+):
+    """Threaded batch of ecmul_double.
+
+    u1s/u2s: uint8[n, 32] big-endian scalars; pubs: uint8[n, 33] compressed
+    keys. Returns (ok uint8[n], x uint8[n, 32]).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    u1s = np.ascontiguousarray(u1s, dtype=np.uint8)
+    u2s = np.ascontiguousarray(u2s, dtype=np.uint8)
+    pubs = np.ascontiguousarray(pubs, dtype=np.uint8)
+    n = u1s.shape[0]
+    out_x = np.zeros((n, 32), dtype=np.uint8)
+    ok = np.zeros(n, dtype=np.uint8)
+    lib.secp256k1_ecmul_double_batch(
+        _ptr(u1s), _ptr(u2s), _ptr(pubs), n, _ptr(out_x), _ptr(ok), nthreads
+    )
+    return ok, out_x

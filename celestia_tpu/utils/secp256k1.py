@@ -135,25 +135,11 @@ class PublicKey:
         return cls(x, y)
 
     def verify(self, msg: bytes, sig: bytes) -> bool:
-        if len(sig) != 64:
+        pre = _verify_scalars(msg, sig)
+        if pre is None:
             return False
-        r = int.from_bytes(sig[:32], "big")
-        s = int.from_bytes(sig[32:], "big")
-        if not (1 <= r < N and 1 <= s < N):
-            return False
-        if s > N // 2:
-            # Reject non-canonical high-s signatures.  Accepting (r, N-s)
-            # alongside (r, s) lets any third party malleate an in-flight tx
-            # into a different tx hash that still executes — breaking
-            # confirm-by-hash lookup and mempool dedup.  Mirrors the low-s
-            # rule sign() already enforces and the reference's secp256k1
-            # behavior (SURVEY.md §2.2).
-            return False
-        z = int.from_bytes(hashlib.sha256(msg).digest(), "big") % N
-        w = _inv(s, N)
-        u1 = z * w % N
-        u2 = r * w % N
-        pt = _point_add(_point_mul(u1, (Gx, Gy)), _point_mul(u2, (self.x, self.y)))
+        r, u1, u2 = pre
+        pt = _ecmul_double(u1, u2, self)
         if pt is None:
             return False
         return pt[0] % N == r
@@ -161,3 +147,90 @@ class PublicKey:
     def address(self) -> bytes:
         """20-byte account address: sha256(compressed pubkey)[:20]."""
         return hashlib.sha256(self.compressed()).digest()[:20]
+
+
+def _verify_scalars(msg: bytes, sig: bytes):
+    """Shared ECDSA pre-checks + scalar math; (r, u1, u2) or None.
+
+    Rejects non-canonical high-s signatures: accepting (r, N-s) alongside
+    (r, s) lets any third party malleate an in-flight tx into a different tx
+    hash that still executes — breaking confirm-by-hash lookup and mempool
+    dedup.  Mirrors the low-s rule sign() enforces and the reference's
+    secp256k1 behavior (SURVEY.md §2.2).
+    """
+    if len(sig) != 64:
+        return None
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    if not (1 <= r < N and 1 <= s < N):
+        return None
+    if s > N // 2:
+        return None
+    z = int.from_bytes(hashlib.sha256(msg).digest(), "big") % N
+    w = _inv(s, N)
+    return r, z * w % N, r * w % N
+
+
+def _ecmul_double(u1: int, u2: int, pub: "PublicKey"):
+    """u1*G + u2*pub — native C when available, pure Python otherwise."""
+    from celestia_tpu.utils import native
+
+    if native.available():
+        got = native.ecmul_double(
+            u1.to_bytes(32, "big"), u2.to_bytes(32, "big"), pub.compressed()
+        )
+        if got is None:
+            return None
+        return int.from_bytes(got[0], "big"), int.from_bytes(got[1], "big")
+    return _point_add(_point_mul(u1, (Gx, Gy)), _point_mul(u2, (pub.x, pub.y)))
+
+
+def verify_batch(msgs, sigs, pubkeys) -> list:
+    """Verify many (msg, sig, compressed-pubkey) triples at once.
+
+    Uses the threaded native batch path when available (the reference's
+    analogue is per-tx C secp256k1 verification inside FilterTxs /
+    ProcessProposal — app/validate_txs.go:39-97); falls back to sequential
+    verify otherwise.  Returns a list of bools.
+    """
+    import numpy as np
+
+    from celestia_tpu.utils import native
+
+    n = len(msgs)
+    if not (len(sigs) == len(pubkeys) == n):
+        raise ValueError("msgs/sigs/pubkeys length mismatch")
+    if not native.available():
+        out = []
+        for msg, sig, raw in zip(msgs, sigs, pubkeys):
+            try:
+                pk = PublicKey.from_compressed(raw)
+            except ValueError:
+                out.append(False)
+                continue
+            out.append(pk.verify(msg, sig))
+        return out
+
+    results = [False] * n
+    u1s = np.zeros((n, 32), dtype=np.uint8)
+    u2s = np.zeros((n, 32), dtype=np.uint8)
+    pubs = np.zeros((n, 33), dtype=np.uint8)
+    rs = [0] * n
+    live = np.zeros(n, dtype=bool)
+    for i, (msg, sig, raw) in enumerate(zip(msgs, sigs, pubkeys)):
+        pre = _verify_scalars(msg, sig)
+        if pre is None or len(raw) != 33 or raw[0] not in (2, 3):
+            continue
+        r, u1, u2 = pre
+        rs[i] = r
+        u1s[i] = np.frombuffer(u1.to_bytes(32, "big"), dtype=np.uint8)
+        u2s[i] = np.frombuffer(u2.to_bytes(32, "big"), dtype=np.uint8)
+        pubs[i] = np.frombuffer(raw, dtype=np.uint8)
+        live[i] = True
+    if not live.any():
+        return results
+    ok, xs = native.ecmul_double_batch(u1s, u2s, pubs)
+    for i in range(n):
+        if live[i] and ok[i]:
+            results[i] = int.from_bytes(xs[i].tobytes(), "big") % N == rs[i]
+    return results

@@ -35,6 +35,32 @@ class Telemetry:
             out[name] = {
                 "count": len(s),
                 "p50_ms": s[len(s) // 2] * 1000 if s else 0.0,
+                "p95_ms": s[int(len(s) * 0.95)] * 1000 if s else 0.0,
                 "max_ms": s[-1] * 1000 if s else 0.0,
             }
         return out
+
+    def export_prometheus(self) -> str:
+        """Prometheus text exposition (the node-level metrics endpoint role
+        — comet's DefaultMetricsProvider, test/util/testnode/full_node.go:44)."""
+        lines: List[str] = []
+        for name, val in sorted(self.counters.items()):
+            metric = f"celestia_tpu_{name}_total"
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {val}")
+        for name, val in sorted(self.gauges.items()):
+            metric = f"celestia_tpu_{name}"
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {val}")
+        for name, vals in sorted(self.timings.items()):
+            metric = f"celestia_tpu_{name}_seconds"
+            s = sorted(vals)
+            lines.append(f"# TYPE {metric} summary")
+            for q in (0.5, 0.95, 0.99):
+                idx = min(len(s) - 1, int(len(s) * q))
+                lines.append(
+                    f'{metric}{{quantile="{q}"}} {s[idx] if s else 0.0:.6f}'
+                )
+            lines.append(f"{metric}_count {len(s)}")
+            lines.append(f"{metric}_sum {sum(s):.6f}")
+        return "\n".join(lines) + "\n"

@@ -16,6 +16,9 @@
 #include <cstdint>
 #include <cstring>
 
+#include <thread>
+#include <vector>
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -243,6 +246,597 @@ void eds_nmt_roots(const uint8_t* eds, int k, int B, uint8_t* out) {
         nmt_root(leaves, n, leaf_len, out + (size_t)(n + c) * DIGEST);
     }
     delete[] leaves;
+}
+
+// ---------------------------------------------------------------------------
+// Threaded full CPU pipeline: extend + all NMT axis roots + data root.
+// This is the honest CPU comparison leg for bench.py (the role Leopard-RS +
+// crypto/sha256 play for the reference, SURVEY.md §2.2): full k, threaded,
+// no extrapolation.
+// ---------------------------------------------------------------------------
+
+static void rfc6962_root_pow2_cpu(const uint8_t* leaves, int n, int leaf_len,
+                                  uint8_t* out32) {
+    // n a power of two; leaf hash = sha256(0x00||leaf), inner = sha256(0x01||l||r)
+    uint8_t* lvl = new uint8_t[(size_t)n * 32];
+    uint8_t buf[1 + 256];
+    for (int i = 0; i < n; i++) {
+        buf[0] = 0x00;
+        memcpy(buf + 1, leaves + (size_t)i * leaf_len, leaf_len);
+        sha256_one(buf, 1 + leaf_len, lvl + (size_t)i * 32);
+    }
+    int m = n;
+    while (m > 1) {
+        for (int i = 0; i < m / 2; i++) {
+            buf[0] = 0x01;
+            memcpy(buf + 1, lvl + (size_t)(2 * i) * 32, 32);
+            memcpy(buf + 33, lvl + (size_t)(2 * i + 1) * 32, 32);
+            sha256_one(buf, 65, lvl + (size_t)i * 32);
+        }
+        m /= 2;
+    }
+    memcpy(out32, lvl, 32);
+    delete[] lvl;
+}
+
+// Full ExtendBlock on the CPU: square k*k*B -> EDS 2k*2k*B, 4k NMT axis
+// roots (4k x 90) and the RFC-6962 data root (32 bytes), using nthreads
+// worker threads (0 = hardware concurrency).
+void extend_block_cpu(const uint8_t* square, const uint8_t* E, int k, int B,
+                      int nthreads, uint8_t* eds, uint8_t* roots,
+                      uint8_t* data_root) {
+    gf_init();
+    if (nthreads <= 0) {
+        nthreads = (int)std::thread::hardware_concurrency();
+        if (nthreads <= 0) nthreads = 1;
+    }
+    const int n = 2 * k;
+    const size_t row_bytes = (size_t)n * B;
+    auto run = [&](void (*fn)(void*, int, int), void* ctx, int count) {
+        int nt = nthreads < count ? nthreads : count;
+        if (nt <= 1) {
+            fn(ctx, 0, 1);
+            return;
+        }
+        std::vector<std::thread> ts;
+        for (int t = 0; t < nt; t++) ts.emplace_back(fn, ctx, t, nt);
+        for (auto& th : ts) th.join();
+    };
+    struct Ctx {
+        const uint8_t* square;
+        const uint8_t* E;
+        uint8_t* eds;
+        uint8_t* roots;
+        int k, B, n;
+        size_t row_bytes;
+    } ctx = {square, E, eds, roots, k, B, n, row_bytes};
+    // Q0 + Q1 per original row, rows striped across threads
+    run(
+        [](void* p, int t, int nt) {
+            Ctx& c = *(Ctx*)p;
+            for (int r = t; r < c.k; r += nt) {
+                memcpy(c.eds + r * c.row_bytes, c.square + (size_t)r * c.k * c.B,
+                       (size_t)c.k * c.B);
+                rs_encode_axis(c.E, c.eds + r * c.row_bytes,
+                               c.eds + r * c.row_bytes + (size_t)c.k * c.B, c.k,
+                               c.B);
+            }
+        },
+        &ctx, k);
+    // Q2/Q3 per column, striped
+    run(
+        [](void* p, int t, int nt) {
+            Ctx& c = *(Ctx*)p;
+            uint8_t* col = new uint8_t[(size_t)c.k * c.B];
+            uint8_t* par = new uint8_t[(size_t)c.k * c.B];
+            for (int cc = t; cc < c.n; cc += nt) {
+                for (int r = 0; r < c.k; r++)
+                    memcpy(col + (size_t)r * c.B,
+                           c.eds + r * c.row_bytes + (size_t)cc * c.B, c.B);
+                rs_encode_axis(c.E, col, par, c.k, c.B);
+                for (int r = 0; r < c.k; r++)
+                    memcpy(c.eds + (size_t)(c.k + r) * c.row_bytes +
+                               (size_t)cc * c.B,
+                           par + (size_t)r * c.B, c.B);
+            }
+            delete[] col;
+            delete[] par;
+        },
+        &ctx, n);
+    // 4k NMT axis roots, striped (rows then cols; axis index a in [0, 2n))
+    run(
+        [](void* p, int t, int nt) {
+            Ctx& c = *(Ctx*)p;
+            const int leaf_len = NS + c.B;
+            uint8_t* leaves = new uint8_t[(size_t)c.n * leaf_len];
+            for (int a = t; a < 2 * c.n; a += nt) {
+                const int is_col = a >= c.n;
+                const int idx = is_col ? a - c.n : a;
+                for (int j = 0; j < c.n; j++) {
+                    const int r = is_col ? j : idx;
+                    const int col = is_col ? idx : j;
+                    const uint8_t* cell = c.eds + ((size_t)r * c.n + col) * c.B;
+                    uint8_t* leaf = leaves + (size_t)j * leaf_len;
+                    if (r < c.k && col < c.k) memcpy(leaf, cell, NS);
+                    else memset(leaf, 0xFF, NS);
+                    memcpy(leaf + NS, cell, c.B);
+                }
+                nmt_root(leaves, c.n, leaf_len, c.roots + (size_t)a * DIGEST);
+            }
+            delete[] leaves;
+        },
+        &ctx, 2 * n);
+    rfc6962_root_pow2_cpu(roots, 2 * n, DIGEST, data_root);
+}
+
+// ---------------------------------------------------------------------------
+// secp256k1 point arithmetic (host-native signature verification)
+//
+// Role: the reference leans on a C secp256k1 library for tx signature
+// verification (decred secp256k1, SURVEY.md §2.2; go.mod:82) — a full square
+// of PFBs means hundreds of ECDSA verifies per ProcessProposal, which would
+// dominate block time in pure Python.  This implements the expensive part
+// (double-scalar point multiplication u1*G + u2*Q over the curve) natively;
+// the cheap scalar arithmetic mod the group order stays in Python, where
+// CPython's pow() is already C.
+//
+// Field: GF(p), p = 2^256 - 0x1000003D1, four 64-bit limbs (little-endian),
+// fully reduced between ops; products via unsigned __int128 with the
+// standard two-stage fold of the high 256 bits (2^256 ≡ 0x1000003D1 mod p).
+// Points: Jacobian coordinates, a=0 curve.  Scalars arrive as 32-byte
+// big-endian from Python; the double multiplication runs a joint wNAF loop
+// (w=8 fixed table of odd multiples of G built once; w=5 odd multiples of Q
+// per call).  Verification-only — nothing here handles secret data, so no
+// constant-time discipline is needed.
+// ---------------------------------------------------------------------------
+
+typedef unsigned __int128 u128;
+
+struct Fe {
+    uint64_t v[4];  // little-endian limbs, fully reduced (< p)
+};
+
+static const Fe FE_P = {{0xFFFFFFFEFFFFFC2FULL, 0xFFFFFFFFFFFFFFFFULL,
+                         0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFFFFFFFFFFULL}};
+static const uint64_t P_C = 0x1000003D1ULL;  // 2^256 - p
+
+static inline int fe_is_zero(const Fe& a) {
+    return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+static inline int fe_cmp(const Fe& a, const Fe& b) {
+    for (int i = 3; i >= 0; i--) {
+        if (a.v[i] < b.v[i]) return -1;
+        if (a.v[i] > b.v[i]) return 1;
+    }
+    return 0;
+}
+
+// r = a - b, assuming a >= b.
+static inline void fe_sub_raw(Fe& r, const Fe& a, const Fe& b) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)a.v[i] - b.v[i] - borrow;
+        r.v[i] = (uint64_t)d;
+        borrow = (d >> 64) & 1;
+    }
+}
+
+static inline void fe_add(Fe& r, const Fe& a, const Fe& b) {
+    u128 carry = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 s = (u128)a.v[i] + b.v[i] + carry;
+        r.v[i] = (uint64_t)s;
+        carry = s >> 64;
+    }
+    if (carry) {
+        // r held (a+b) mod 2^256; a+b-p = r + C, which stays < p (a,b < p).
+        u128 c = P_C;
+        for (int i = 0; i < 4 && c; i++) {
+            u128 s = (u128)r.v[i] + c;
+            r.v[i] = (uint64_t)s;
+            c = s >> 64;
+        }
+    } else if (fe_cmp(r, FE_P) >= 0) {
+        fe_sub_raw(r, r, FE_P);
+    }
+}
+
+static inline void fe_sub(Fe& r, const Fe& a, const Fe& b) {
+    if (fe_cmp(a, b) >= 0) {
+        fe_sub_raw(r, a, b);
+    } else {
+        Fe t;
+        fe_sub_raw(t, b, a);      // t = b - a
+        fe_sub_raw(r, FE_P, t);   // r = p - t = a - b + p
+    }
+}
+
+static void fe_mul(Fe& r, const Fe& a, const Fe& b) {
+    uint64_t t[8] = {0};
+    for (int i = 0; i < 4; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 cur = (u128)a.v[i] * b.v[j] + t[i + j] + carry;
+            t[i + j] = (uint64_t)cur;
+            carry = cur >> 64;
+        }
+        t[i + 4] = (uint64_t)carry;
+    }
+    // fold high 256 bits: t[0..3] += t[4..7] * C
+    uint64_t r4 = 0;
+    {
+        u128 carry = 0;
+        for (int i = 0; i < 4; i++) {
+            u128 cur = (u128)t[i + 4] * P_C + t[i] + carry;
+            t[i] = (uint64_t)cur;
+            carry = cur >> 64;
+        }
+        r4 = (uint64_t)carry;  // < 2^34
+    }
+    // fold the overflow limb
+    {
+        u128 carry = (u128)r4 * P_C;
+        for (int i = 0; i < 4 && carry; i++) {
+            u128 cur = (u128)t[i] + (uint64_t)carry;
+            t[i] = (uint64_t)cur;
+            carry = (carry >> 64) + (cur >> 64);
+        }
+        if (carry) {  // wrapped past 2^256 once more: add C
+            u128 c = P_C;
+            for (int i = 0; i < 4 && c; i++) {
+                u128 cur = (u128)t[i] + c;
+                t[i] = (uint64_t)cur;
+                c = cur >> 64;
+            }
+        }
+    }
+    Fe out = {{t[0], t[1], t[2], t[3]}};
+    if (fe_cmp(out, FE_P) >= 0) fe_sub_raw(out, out, FE_P);
+    r = out;
+}
+
+static inline void fe_sqr(Fe& r, const Fe& a) { fe_mul(r, a, a); }
+
+// r = base^e (e big-endian bytes), square-and-multiply.
+static void fe_pow(Fe& r, const Fe& base, const uint8_t e[32]) {
+    Fe acc = {{1, 0, 0, 0}};
+    for (int i = 0; i < 32; i++) {
+        for (int bit = 7; bit >= 0; bit--) {
+            fe_sqr(acc, acc);
+            if ((e[i] >> bit) & 1) fe_mul(acc, acc, base);
+        }
+    }
+    r = acc;
+}
+
+static void fe_inv(Fe& r, const Fe& a) {
+    static const uint8_t P_MINUS_2[32] = {
+        0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+        0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+        0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFE, 0xFF, 0xFF, 0xFC, 0x2D};
+    fe_pow(r, a, P_MINUS_2);
+}
+
+static void fe_sqrt(Fe& r, const Fe& a) {
+    // p ≡ 3 (mod 4): sqrt = a^((p+1)/4); caller must check r^2 == a.
+    static const uint8_t EXP[32] = {
+        0x3F, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+        0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+        0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xBF, 0xFF, 0xFF, 0x0C};
+    fe_pow(r, a, EXP);
+}
+
+static void fe_from_bytes(Fe& r, const uint8_t b[32]) {
+    for (int i = 0; i < 4; i++) {
+        uint64_t limb = 0;
+        for (int j = 0; j < 8; j++) limb = (limb << 8) | b[(3 - i) * 8 + j];
+        r.v[i] = limb;
+    }
+}
+
+static void fe_to_bytes(uint8_t b[32], const Fe& a) {
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++)
+            b[(3 - i) * 8 + j] = (uint8_t)(a.v[i] >> (8 * (7 - j)));
+}
+
+static inline void fe_neg(Fe& r, const Fe& a) {
+    if (fe_is_zero(a)) { r = a; return; }
+    fe_sub_raw(r, FE_P, a);
+}
+
+// Jacobian point; infinity encoded as z == 0.
+struct Jac {
+    Fe x, y, z;
+};
+struct Aff {
+    Fe x, y;
+};
+
+static const Jac JAC_INF = {{{0, 0, 0, 0}}, {{1, 0, 0, 0}}, {{0, 0, 0, 0}}};
+
+static inline int jac_is_inf(const Jac& p) { return fe_is_zero(p.z); }
+
+static void jac_dbl(Jac& r, const Jac& p) {
+    // Writes go through temporaries: callers double in place (r aliases p).
+    if (jac_is_inf(p) || fe_is_zero(p.y)) { r = JAC_INF; return; }
+    Fe A, B, C, D, E, F, t, t2, x3, y3, z3;
+    fe_sqr(A, p.x);               // A = X^2
+    fe_sqr(B, p.y);               // B = Y^2
+    fe_sqr(C, B);                 // C = B^2
+    fe_add(t, p.x, B);
+    fe_sqr(t, t);
+    fe_sub(t, t, A);
+    fe_sub(t, t, C);
+    fe_add(D, t, t);              // D = 2((X+B)^2 - A - C)
+    fe_add(E, A, A);
+    fe_add(E, E, A);              // E = 3A
+    fe_sqr(F, E);                 // F = E^2
+    fe_add(t, D, D);
+    fe_sub(x3, F, t);             // X3 = F - 2D
+    fe_sub(t, D, x3);
+    fe_mul(t, E, t);
+    fe_add(t2, C, C);
+    fe_add(t2, t2, t2);
+    fe_add(t2, t2, t2);           // 8C
+    fe_sub(y3, t, t2);            // Y3 = E(D - X3) - 8C
+    fe_mul(t, p.y, p.z);
+    fe_add(z3, t, t);             // Z3 = 2YZ
+    r.x = x3;
+    r.y = y3;
+    r.z = z3;
+}
+
+static void jac_add(Jac& r, const Jac& p, const Jac& q) {
+    if (jac_is_inf(p)) { r = q; return; }
+    if (jac_is_inf(q)) { r = p; return; }
+    Fe z1z1, z2z2, u1, u2, s1, s2, t;
+    fe_sqr(z1z1, p.z);
+    fe_sqr(z2z2, q.z);
+    fe_mul(u1, p.x, z2z2);
+    fe_mul(u2, q.x, z1z1);
+    fe_mul(t, q.z, z2z2);
+    fe_mul(s1, p.y, t);
+    fe_mul(t, p.z, z1z1);
+    fe_mul(s2, q.y, t);
+    Fe h, rr;
+    fe_sub(h, u2, u1);
+    fe_sub(rr, s2, s1);
+    if (fe_is_zero(h)) {
+        if (fe_is_zero(rr)) { jac_dbl(r, p); return; }
+        r = JAC_INF;
+        return;
+    }
+    Fe h2, h3, u1h2;
+    fe_sqr(h2, h);
+    fe_mul(h3, h, h2);
+    fe_mul(u1h2, u1, h2);
+    fe_sqr(t, rr);
+    fe_sub(t, t, h3);
+    fe_sub(t, t, u1h2);
+    fe_sub(r.x, t, u1h2);         // X3 = R^2 - H^3 - 2 U1 H^2
+    fe_sub(t, u1h2, r.x);
+    fe_mul(t, rr, t);
+    Fe s1h3;
+    fe_mul(s1h3, s1, h3);
+    fe_sub(r.y, t, s1h3);         // Y3 = R(U1 H^2 - X3) - S1 H^3
+    fe_mul(t, p.z, q.z);
+    fe_mul(r.z, t, h);            // Z3 = Z1 Z2 H
+}
+
+// Mixed addition: q affine (z = 1).
+static void jac_add_aff(Jac& r, const Jac& p, const Aff& q) {
+    if (jac_is_inf(p)) {
+        r.x = q.x;
+        r.y = q.y;
+        r.z = {{1, 0, 0, 0}};
+        return;
+    }
+    Fe z1z1, u2, s2, t;
+    fe_sqr(z1z1, p.z);
+    fe_mul(u2, q.x, z1z1);
+    fe_mul(t, p.z, z1z1);
+    fe_mul(s2, q.y, t);
+    Fe h, rr;
+    fe_sub(h, u2, p.x);
+    fe_sub(rr, s2, p.y);
+    if (fe_is_zero(h)) {
+        if (fe_is_zero(rr)) { jac_dbl(r, p); return; }
+        r = JAC_INF;
+        return;
+    }
+    Fe h2, h3, u1h2;
+    fe_sqr(h2, h);
+    fe_mul(h3, h, h2);
+    fe_mul(u1h2, p.x, h2);
+    fe_sqr(t, rr);
+    fe_sub(t, t, h3);
+    fe_sub(t, t, u1h2);
+    fe_sub(r.x, t, u1h2);
+    fe_sub(t, u1h2, r.x);
+    fe_mul(t, rr, t);
+    Fe s1h3;
+    fe_mul(s1h3, p.y, h3);
+    fe_sub(r.y, t, s1h3);
+    fe_mul(r.z, p.z, h);
+}
+
+static int jac_to_aff(Aff& r, const Jac& p) {
+    if (jac_is_inf(p)) return 0;
+    Fe zi, zi2;
+    fe_inv(zi, p.z);
+    fe_sqr(zi2, zi);
+    fe_mul(r.x, p.x, zi2);
+    fe_mul(zi2, zi2, zi);
+    fe_mul(r.y, p.y, zi2);
+    return 1;
+}
+
+// --- fixed G table: odd multiples 1G, 3G, ..., 255G (wNAF window 8) ---
+
+static Aff G_TAB[128];
+static int g_tab_ready = 0;
+
+static void secp_init(void) {
+    if (g_tab_ready) return;
+    static const uint8_t GX[32] = {
+        0x79, 0xBE, 0x66, 0x7E, 0xF9, 0xDC, 0xBB, 0xAC, 0x55, 0xA0, 0x62,
+        0x95, 0xCE, 0x87, 0x0B, 0x07, 0x02, 0x9B, 0xFC, 0xDB, 0x2D, 0xCE,
+        0x28, 0xD9, 0x59, 0xF2, 0x81, 0x5B, 0x16, 0xF8, 0x17, 0x98};
+    static const uint8_t GY[32] = {
+        0x48, 0x3A, 0xDA, 0x77, 0x26, 0xA3, 0xC4, 0x65, 0x5D, 0xA4, 0xFB,
+        0xFC, 0x0E, 0x11, 0x08, 0xA8, 0xFD, 0x17, 0xB4, 0x48, 0xA6, 0x85,
+        0x54, 0x19, 0x9C, 0x47, 0xD0, 0x8F, 0xFB, 0x10, 0xD4, 0xB8};
+    Jac g;
+    fe_from_bytes(g.x, GX);
+    fe_from_bytes(g.y, GY);
+    g.z = {{1, 0, 0, 0}};
+    Jac g2;
+    jac_dbl(g2, g);
+    Jac cur = g;
+    for (int i = 0; i < 128; i++) {
+        jac_to_aff(G_TAB[i], cur);
+        jac_add(cur, cur, g2);
+    }
+    g_tab_ready = 1;
+}
+
+// wNAF encoding of a 256-bit big-endian scalar. digits out (LSB first),
+// values odd in (-2^(w-1), 2^(w-1)); returns length.
+static int wnaf_encode(const uint8_t scalar_be[32], int w, int8_t* digits) {
+    uint64_t k[5] = {0, 0, 0, 0, 0};
+    for (int i = 0; i < 4; i++) {
+        uint64_t limb = 0;
+        for (int j = 0; j < 8; j++) limb = (limb << 8) | scalar_be[(3 - i) * 8 + j];
+        k[i] = limb;
+    }
+    int len = 0;
+    const uint64_t mask = (1ULL << w) - 1;
+    const int64_t half = 1LL << (w - 1);
+    while (k[0] | k[1] | k[2] | k[3] | k[4]) {
+        int8_t d = 0;
+        if (k[0] & 1) {
+            int64_t m = (int64_t)(k[0] & mask);
+            if (m >= half) m -= (int64_t)(mask + 1);
+            d = (int8_t)m;
+            if (m >= 0) {
+                u128 borrow = 0;
+                uint64_t sub = (uint64_t)m;
+                for (int i = 0; i < 5; i++) {
+                    u128 diff = (u128)k[i] - (i == 0 ? sub : 0) - borrow;
+                    k[i] = (uint64_t)diff;
+                    borrow = (diff >> 64) & 1;
+                }
+            } else {
+                u128 carry = (uint64_t)(-m);
+                for (int i = 0; i < 5 && carry; i++) {
+                    u128 s = (u128)k[i] + carry;
+                    k[i] = (uint64_t)s;
+                    carry = s >> 64;
+                }
+            }
+        }
+        digits[len++] = d;
+        // k >>= 1
+        for (int i = 0; i < 4; i++) k[i] = (k[i] >> 1) | (k[i + 1] << 63);
+        k[4] >>= 1;
+    }
+    return len;
+}
+
+// Decompress a 33-byte SEC1 public key into affine coords. Returns 1 if ok.
+static int pubkey_decompress(const uint8_t pub33[33], Aff& out) {
+    if (pub33[0] != 0x02 && pub33[0] != 0x03) return 0;
+    Fe x;
+    fe_from_bytes(x, pub33 + 1);
+    if (fe_cmp(x, FE_P) >= 0) return 0;  // fe_from_bytes does not reduce
+    Fe y2, t;
+    fe_sqr(t, x);
+    fe_mul(y2, t, x);
+    Fe seven = {{7, 0, 0, 0}};
+    fe_add(y2, y2, seven);
+    Fe y;
+    fe_sqrt(y, y2);
+    fe_sqr(t, y);
+    if (fe_cmp(t, y2) != 0) return 0;  // not a quadratic residue
+    if ((y.v[0] & 1) != (uint64_t)(pub33[0] & 1)) fe_neg(y, y);
+    out.x = x;
+    out.y = y;
+    return 1;
+}
+
+// R = u1*G + u2*Q for a compressed pubkey Q; returns 1 and writes the affine
+// coordinates of R unless R is infinity / pubkey invalid.  This is the hot
+// inner op of ECDSA verification; the caller (Python) computes u1, u2 and
+// checks x(R) mod n == r.
+int secp256k1_ecmul_double(const uint8_t* u1_be, const uint8_t* u2_be,
+                           const uint8_t* pub33, uint8_t* out_x,
+                           uint8_t* out_y) {
+    secp_init();
+    Aff q;
+    if (!pubkey_decompress(pub33, q)) return 0;
+    // odd multiples of Q: 1Q, 3Q, ..., 15Q (w = 5)
+    Jac qtab[8];
+    qtab[0].x = q.x;
+    qtab[0].y = q.y;
+    qtab[0].z = {{1, 0, 0, 0}};
+    Jac q2;
+    jac_dbl(q2, qtab[0]);
+    for (int i = 1; i < 8; i++) jac_add(qtab[i], qtab[i - 1], q2);
+
+    int8_t n1[260], n2[260];
+    int l1 = wnaf_encode(u1_be, 8, n1);
+    int l2 = wnaf_encode(u2_be, 5, n2);
+    int len = l1 > l2 ? l1 : l2;
+    Jac r = JAC_INF;
+    for (int i = len - 1; i >= 0; i--) {
+        jac_dbl(r, r);
+        if (i < l1 && n1[i]) {
+            int8_t d = n1[i];
+            Aff a = G_TAB[(d > 0 ? d : -d) >> 1];
+            if (d < 0) fe_neg(a.y, a.y);
+            jac_add_aff(r, r, a);
+        }
+        if (i < l2 && n2[i]) {
+            int8_t d = n2[i];
+            Jac p = qtab[(d > 0 ? d : -d) >> 1];
+            if (d < 0) fe_neg(p.y, p.y);
+            jac_add(r, r, p);
+        }
+    }
+    Aff ra;
+    if (!jac_to_aff(ra, r)) return 0;
+    fe_to_bytes(out_x, ra.x);
+    fe_to_bytes(out_y, ra.y);
+    return 1;
+}
+
+// Batched double-multiplication across worker threads.
+// u1s/u2s: n*32 big-endian scalars; pubs: n*33; out_x: n*32; ok: n flags.
+void secp256k1_ecmul_double_batch(const uint8_t* u1s, const uint8_t* u2s,
+                                  const uint8_t* pubs, int n, uint8_t* out_x,
+                                  uint8_t* ok, int nthreads) {
+    secp_init();
+    if (nthreads <= 0) {
+        nthreads = (int)std::thread::hardware_concurrency();
+        if (nthreads <= 0) nthreads = 1;
+    }
+    if (nthreads > n) nthreads = n > 0 ? n : 1;
+    auto work = [&](int t) {
+        uint8_t oy[32];
+        for (int i = t; i < n; i += nthreads)
+            ok[i] = (uint8_t)secp256k1_ecmul_double(
+                u1s + (size_t)i * 32, u2s + (size_t)i * 32,
+                pubs + (size_t)i * 33, out_x + (size_t)i * 32, oy);
+    };
+    if (nthreads == 1) {
+        work(0);
+    } else {
+        std::vector<std::thread> ts;
+        for (int t = 0; t < nthreads; t++) ts.emplace_back(work, t);
+        for (auto& th : ts) th.join();
+    }
 }
 
 }  // extern "C"

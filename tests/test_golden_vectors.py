@@ -1,0 +1,206 @@
+"""Cross-implementation golden vectors (VERDICT r1 item #6).
+
+Every fixture here is a constant from outside this repository:
+
+- SHA-256: FIPS 180-4 / NIST test vectors.
+- RFC-6962 binary Merkle roots: the Certificate Transparency reference test
+  corpus (certificate-transparency-go merkle tests) — the same hash rule the
+  reference uses for the data root (`specs/src/specs/data_structures.md:184-204`
+  cites RFC-6962 and pins the empty root literal).
+- RFC-6979 deterministic ECDSA on secp256k1: the community test vectors for
+  (privkey 1, "Satoshi Nakamoto"), etc., reproduced across bitcoin-core,
+  trezor, and python-ecdsa test suites.
+- NMT empty root: the literal in the reference spec
+  (`specs/src/specs/data_structures.md:231-235`).
+
+A shared misreading of a spec by this repo's device kernels AND its host
+reference implementations cannot survive these pins.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from celestia_tpu.ops import nmt as nmt_ops
+from celestia_tpu.ops import sha256 as sha_ops
+from celestia_tpu.utils import native
+from celestia_tpu.utils.secp256k1 import N, PrivateKey
+
+# --------------------------------------------------------------------------
+# SHA-256 (FIPS 180-4)
+# --------------------------------------------------------------------------
+
+SHA_VECTORS = [
+    (b"", "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"),
+    (b"abc", "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"),
+    (
+        b"abcdbcdecdefdefgefghfghighijhijkijkljklmklmnlmnomnopnopq",
+        "248d6a61d20638b8e5c026930c3e6039a33ce45964ff2167f6ecedd419db06c1",
+    ),
+    (
+        b"abcdefghbcdefghicdefghijdefghijkefghijklfghijklmghijklmn"
+        b"hijklmnoijklmnopjklmnopqklmnopqrlmnopqrsmnopqrstnopqrstu",
+        "cf5b16a778af8380036ce59e7b0492370b249b11e8f07a51afac45037afee9d1",
+    ),
+]
+
+
+def test_sha256_device_fips_vectors():
+    for msg, want in SHA_VECTORS:
+        arr = np.frombuffer(msg, dtype=np.uint8).reshape(1, -1)
+        got = bytes(np.asarray(sha_ops.sha256(arr))[0])
+        assert got.hex() == want, msg
+
+
+def test_sha256_native_fips_vectors():
+    if not native.available():
+        pytest.skip("native library unavailable")
+    for msg, want in SHA_VECTORS:
+        arr = np.frombuffer(msg, dtype=np.uint8).reshape(1, -1)
+        got = bytes(native.sha256_batch(arr)[0])
+        assert got.hex() == want, msg
+
+
+# --------------------------------------------------------------------------
+# RFC-6962 binary Merkle tree (Certificate Transparency test corpus)
+# --------------------------------------------------------------------------
+
+CT_LEAVES = [
+    bytes.fromhex(h)
+    for h in [
+        "",
+        "00",
+        "10",
+        "2021",
+        "3031",
+        "40414243",
+        "5051525354555657",
+        "606162636465666768696a6b6c6d6e6f",
+    ]
+]
+CT_ROOTS = [
+    "6e340b9cffb37a989ca544e6bb780a2c78901d3fb33738768511a30617afa01d",
+    "fac54203e7cc696cf0dfcb42c92a1d9dbaf70ad9e621f4bd8d98662f00e3c125",
+    "aeb6bcfe274b70a14fb067a5e5578264db0fa9b51af5e0ba159158f329e06e77",
+    "d37ee418976dd95753c1c73862b9398fa2a2cf9b4ff0fdfe8b30cd95209614b7",
+    "4e3bbb1f7b478dcfe71fb631631519a3bca12c9aefca1612bfce4c13a86264d4",
+    "76e67dadbcdf1e10e1b74ddc608abd2f98dfb16fbce75277b5232a127f2087ef",
+    "ddb89be403809e325750d3d263cd78929c2942b7942a34b77e122c9594a74c8c",
+    "5dc9da79a70659a9ad559cb701ded9a2ab9d823aad2f4960cfe370eff4604328",
+]
+EMPTY_ROOT = "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"
+
+
+def test_rfc6962_host_ct_corpus():
+    assert bytes(nmt_ops.rfc6962_root_np([])).hex() == EMPTY_ROOT
+    for n in range(1, 9):
+        got = bytes(nmt_ops.rfc6962_root_np(CT_LEAVES[:n])).hex()
+        assert got == CT_ROOTS[n - 1], f"CT corpus size {n}"
+
+
+def test_rfc6962_device_matches_ct_at_pow2():
+    # the device path requires equal-length leaves and power-of-two counts;
+    # pad the CT corpus to a uniform length and pin against the host rule
+    # (itself pinned against the CT corpus above), plus the single-leaf and
+    # pair cases directly against CT constants where lengths allow.
+    one = np.frombuffer(CT_LEAVES[0], dtype=np.uint8).reshape(1, 0)
+    got = bytes(np.asarray(nmt_ops.rfc6962_root_pow2(one.reshape(1, 0))))
+    assert got.hex() == CT_ROOTS[0]
+    uniform = np.stack(
+        [np.frombuffer(b"%16d" % i, dtype=np.uint8) for i in range(8)]
+    )
+    want = bytes(nmt_ops.rfc6962_root_np([bytes(x) for x in uniform]))
+    got = bytes(np.asarray(nmt_ops.rfc6962_root_pow2(uniform)))
+    assert got == want
+
+
+# --------------------------------------------------------------------------
+# NMT empty root (reference spec literal)
+# --------------------------------------------------------------------------
+
+
+def test_nmt_empty_root_spec_literal():
+    root = bytes(nmt_ops.empty_root_np())
+    ns = nmt_ops.NAMESPACE_SIZE
+    assert root[: 2 * ns] == b"\x00" * (2 * ns)
+    assert root[2 * ns :].hex() == EMPTY_ROOT
+
+
+# --------------------------------------------------------------------------
+# RFC-6979 deterministic ECDSA (secp256k1 community vectors)
+# --------------------------------------------------------------------------
+
+ECDSA_VECTORS = [
+    (
+        1,
+        b"Satoshi Nakamoto",
+        "934b1ea10a4b3c1757e2b0c017d0b6143ce3c9a7e6a4a49860d7a6ab210ee3d8"
+        "2442ce9d2b916064108014783e923ec36b49743e2ffa1c4496f01a512aafd9e5",
+    ),
+    (
+        1,
+        b"All those moments will be lost in time, like tears in rain. "
+        b"Time to die...",
+        "8600dbd41e348fe5c9465ab92d23e3db8b98b873beecd930736488696438cb6b"
+        "547fe64427496db33bf66019dacbf0039c04199abb0122918601db38a72cfc21",
+    ),
+    (
+        N - 1,
+        b"Satoshi Nakamoto",
+        "fd567d121db66e382991534ada77a6bd3106f0a1098c231e47993447cd6af2d0"
+        "6b39cd0eb1bc8603e159ef5c20a5c8ad685a45b06ce9bebed3f153d10d93bed5",
+    ),
+]
+
+
+def test_rfc6979_ecdsa_vectors():
+    for d, msg, want in ECDSA_VECTORS:
+        sk = PrivateKey(d)
+        assert sk.sign(msg).hex() == want
+        pk = sk.public_key()
+        assert pk.verify(msg, bytes.fromhex(want))
+
+
+# --------------------------------------------------------------------------
+# NMT node rule recomputed inline from the spec formula
+# (specs/src/specs/data_structures.md:255-263 + malicious/hasher.go:271-310)
+# --------------------------------------------------------------------------
+
+
+def test_nmt_node_rule_from_spec_formula():
+    ns = nmt_ops.NAMESPACE_SIZE
+    parity = b"\xff" * ns
+    ns_a = bytes([0] * (ns - 1) + [1])
+    ns_b = bytes([0] * (ns - 1) + [2])
+    leaf_a = ns_a + b"payload-a"
+    leaf_b = ns_b + b"payload-b"
+    leaf_p = parity + b"parity-share"
+
+    # leaf: n_min = n_max = namespace, v = h(0x00, ns || data)
+    for leaf in (leaf_a, leaf_b, leaf_p):
+        d = nmt_ops.leaf_digest_np(leaf)
+        assert d[:ns] == leaf[:ns]
+        assert d[ns : 2 * ns] == leaf[:ns]
+        assert d[2 * ns :] == hashlib.sha256(b"\x00" + leaf).digest()
+
+    da = nmt_ops.leaf_digest_np(leaf_a)
+    db = nmt_ops.leaf_digest_np(leaf_b)
+    dp = nmt_ops.leaf_digest_np(leaf_p)
+
+    # ordinary node: min = left.min, max = right.max
+    node = nmt_ops.combine_digests_np(da, db)
+    assert node[:ns] == ns_a
+    assert node[ns : 2 * ns] == ns_b
+    assert node[2 * ns :] == hashlib.sha256(b"\x01" + da + db).digest()
+
+    # ignore-max rule: right child entirely parity -> parent max = left.max
+    node = nmt_ops.combine_digests_np(db, dp)
+    assert node[:ns] == ns_b
+    assert node[ns : 2 * ns] == ns_b, "IgnoreMaxNamespace must drop parity ns"
+    assert node[2 * ns :] == hashlib.sha256(b"\x01" + db + dp).digest()
+
+    # both parity (Q3): range stays parity
+    node = nmt_ops.combine_digests_np(dp, dp)
+    assert node[:ns] == parity
+    assert node[ns : 2 * ns] == parity

@@ -1,0 +1,126 @@
+"""Native secp256k1 verification vs the pure-Python implementation.
+
+The native C++ path (native/celestia_native.cpp secp256k1_*) implements the
+expensive double-scalar point multiplication of ECDSA verification; these
+tests pin it against the pure-Python curve arithmetic and exercise the
+rejection edge cases (high-s, bad pubkeys, infinity results).  Equivalent
+role: the reference's C secp256k1 dependency (SURVEY.md §2.2, go.mod:82).
+"""
+
+import secrets
+
+import pytest
+
+from celestia_tpu.utils import native
+from celestia_tpu.utils.secp256k1 import (
+    Gx,
+    Gy,
+    N,
+    PrivateKey,
+    PublicKey,
+    _point_add,
+    _point_mul,
+    verify_batch,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def test_ecmul_double_matches_python():
+    sk = PrivateKey.from_seed(b"ecmul")
+    pk = sk.public_key()
+    cases = [(0, 0), (1, 0), (0, 1), (2, 2), (255, 16), (N - 1, N - 1)]
+    for _ in range(10):
+        cases.append((secrets.randbelow(N), secrets.randbelow(N)))
+    for u1, u2 in cases:
+        expect = _point_add(
+            _point_mul(u1, (Gx, Gy)), _point_mul(u2, (pk.x, pk.y))
+        )
+        got = native.ecmul_double(
+            u1.to_bytes(32, "big"), u2.to_bytes(32, "big"), pk.compressed()
+        )
+        if expect is None:
+            assert got is None, (u1, u2)
+        else:
+            assert got is not None, (u1, u2)
+            x, y = got
+            assert (int.from_bytes(x, "big"), int.from_bytes(y, "big")) == expect
+
+
+def test_ecmul_double_infinity_and_bad_pubkey():
+    u1 = 98765
+    pk_neg = PrivateKey(N - u1).public_key()
+    # u1*G + 1*(-u1*G) = infinity
+    assert (
+        native.ecmul_double(
+            u1.to_bytes(32, "big"), (1).to_bytes(32, "big"), pk_neg.compressed()
+        )
+        is None
+    )
+    # x not on the curve (x=5: 125+7=132 is a non-residue mod p)
+    bad = bytes([2]) + (5).to_bytes(32, "big")
+    P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+    assert pow(132, (P - 1) // 2, P) != 1
+    assert (
+        native.ecmul_double(
+            (5).to_bytes(32, "big"), (5).to_bytes(32, "big"), bad
+        )
+        is None
+    )
+
+
+def test_verify_roundtrip_and_malleation():
+    sk = PrivateKey.from_seed(b"verify-native")
+    pk = sk.public_key()
+    msg = b"pay for blobs"
+    sig = sk.sign(msg)
+    assert pk.verify(msg, sig)
+    assert not pk.verify(b"other", sig)
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    high = r.to_bytes(32, "big") + (N - s).to_bytes(32, "big")
+    assert not pk.verify(msg, high), "high-s malleation must be rejected"
+
+
+def test_verify_batch_mixed():
+    keys = [PrivateKey.from_seed(bytes([i + 1])) for i in range(6)]
+    msgs = [b"m%d" % i for i in range(6)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+    pubs = [k.public_key().compressed() for k in keys]
+    # tamper one sig, one wrong pubkey, one garbage pubkey
+    sigs[1] = sigs[1][:63] + bytes([sigs[1][63] ^ 1])
+    pubs[2] = pubs[3]
+    pubs[4] = b"\x09" * 33
+    got = verify_batch(msgs, sigs, pubs)
+    assert got == [True, False, False, True, False, True]
+
+
+def test_verify_batch_matches_pure_python_fallback():
+    keys = [PrivateKey.from_seed(bytes([40 + i])) for i in range(3)]
+    msgs = [b"fb%d" % i for i in range(3)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+    pubs = [k.public_key().compressed() for k in keys]
+    sigs[0] = sigs[0][:10] + b"\x00" + sigs[0][11:]
+    native_res = verify_batch(msgs, sigs, pubs)
+    pure = []
+    for m, s, p in zip(msgs, sigs, pubs):
+        pk = PublicKey.from_compressed(p)
+        pre_pt = _point_add(
+            _point_mul(1, (Gx, Gy)), None
+        )  # touch pure helpers so linters keep imports
+        assert pre_pt is not None
+        # pure-python verify: bypass native by direct scalar math
+        from celestia_tpu.utils.secp256k1 import _verify_scalars
+
+        prep = _verify_scalars(m, s)
+        if prep is None:
+            pure.append(False)
+            continue
+        r, u1, u2 = prep
+        pt = _point_add(
+            _point_mul(u1, (Gx, Gy)), _point_mul(u2, (pk.x, pk.y))
+        )
+        pure.append(pt is not None and pt[0] % N == r)
+    assert native_res == pure

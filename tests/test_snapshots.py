@@ -1,0 +1,125 @@
+"""State-sync snapshots: interval creation, pruning, restore continuity.
+
+VERDICT r1 item #7.  Reference: snapshots every 1500 blocks keep-2
+(app/default_overrides.go:296-297), snapshot store + restore wiring
+(cmd/celestia-appd/cmd/root.go:227-243).
+"""
+
+import numpy as np
+import pytest
+
+from celestia_tpu.client.signer import Signer
+from celestia_tpu.da.blob import Blob
+from celestia_tpu.da.namespace import Namespace
+from celestia_tpu.node.snapshots import SnapshotStore
+from celestia_tpu.node.testnode import TestNode
+from celestia_tpu.utils.secp256k1 import PrivateKey
+
+
+def _post_blob(node, signer, seed):
+    rng = np.random.default_rng(seed)
+    ns = Namespace.v0(b"snaptest-%d" % (seed % 10))
+    data = rng.integers(0, 256, 700, dtype=np.uint8).tobytes()
+    res = signer.submit_pay_for_blob([Blob(ns, data)])
+    assert res.code == 0, res.log
+    return res
+
+
+def test_interval_snapshots_prune_and_restore(tmp_path):
+    alice = PrivateKey.from_seed(b"snap-alice")
+    node = TestNode(
+        funded_accounts=[(alice, 10**13)],
+        snapshot_dir=str(tmp_path / "snaps"),
+        snapshot_interval=2,
+        snapshot_keep_recent=2,
+    )
+    signer = Signer(node, alice)
+    # every confirmed submission auto-produces one block: heights 2..7;
+    # snapshots at even heights, keep-recent=2 leaves 4 and 6
+    for i in range(6):
+        _post_blob(node, signer, i)
+    assert node.height == 7
+    store = SnapshotStore(str(tmp_path / "snaps"))
+    snaps = store.list()
+    assert [s.height for s in snaps] == [4, 6]
+    assert all(s.chunks >= 1 for s in snaps)
+
+    # kill the node; restore a fresh one from the latest snapshot
+    restored = TestNode.from_snapshot(str(tmp_path / "snaps"), auto_produce=False)
+    assert restored.height == 6
+    assert (
+        restored.app.store.committed_hash(6)
+        == node.app.store.committed_hash(6)
+    )
+    # continuity: replay the original chain's post-snapshot block on the
+    # restored node at the same timestamp -> identical header all the way
+    blk7 = node.block(7)
+    for raw in blk7.txs:
+        res = restored.broadcast_tx(raw)
+        assert res.code == 0, res.log
+    restored._now_ns = blk7.header.time_ns - restored.block_interval_ns
+    b2 = restored.produce_block()
+    assert b2.header.height == 7
+    assert b2.header.data_hash == blk7.header.data_hash
+    assert b2.header.app_hash == blk7.header.app_hash
+
+
+def test_restore_rejects_corrupt_chunk(tmp_path):
+    alice = PrivateKey.from_seed(b"snap-bob")
+    node = TestNode(
+        funded_accounts=[(alice, 10**13)],
+        snapshot_dir=str(tmp_path / "snaps"),
+        snapshot_interval=1,
+        snapshot_keep_recent=1,
+    )
+    signer = Signer(node, alice)
+    _post_blob(node, signer, 1)
+    node.produce_block()
+    store = SnapshotStore(str(tmp_path / "snaps"))
+    info = store.latest()
+    chunk = store.root / info.dirname / "chunk-0000"
+    raw = bytearray(chunk.read_bytes())
+    raw[0] ^= 0xFF
+    chunk.write_bytes(bytes(raw))
+    with pytest.raises(ValueError, match="corrupt"):
+        store.load_state(info)
+
+
+def test_snapshot_roundtrip_without_node(tmp_path):
+    alice = PrivateKey.from_seed(b"snap-solo")
+    node = TestNode(funded_accounts=[(alice, 10**12)])
+    signer = Signer(node, alice)
+    _post_blob(node, signer, 3)
+    node.produce_block()
+    store = SnapshotStore(str(tmp_path / "s"))
+    info = store.create(node.app)
+    assert info.height == node.height
+    app2 = store.restore_app(info)
+    assert app2.store.app_hash() == node.app.store.app_hash()
+    assert app2.bank.balance(alice.public_key().address()) == node.app.bank.balance(
+        alice.public_key().address()
+    )
+
+
+def test_restored_node_keeps_snapshotting(tmp_path):
+    """Review regression: from_snapshot forwards the snapshot interval so a
+    restored node keeps writing snapshots."""
+    alice = PrivateKey.from_seed(b"snap-cont")
+    node = TestNode(
+        funded_accounts=[(alice, 10**13)],
+        snapshot_dir=str(tmp_path / "s"),
+        snapshot_interval=2,
+        snapshot_keep_recent=4,
+    )
+    signer = Signer(node, alice)
+    _post_blob(node, signer, 1)
+    _post_blob(node, signer, 2)  # height 3; snapshot at 2
+    store = SnapshotStore(str(tmp_path / "s"))
+    assert [s.height for s in store.list()] == [2]
+    restored = TestNode.from_snapshot(
+        str(tmp_path / "s"), snapshot_interval=2, snapshot_keep_recent=4
+    )
+    s2 = Signer(restored, alice)
+    _post_blob(restored, s2, 3)
+    _post_blob(restored, s2, 4)  # heights 3,4 -> snapshot at 4
+    assert [s.height for s in store.list()] == [2, 4]

@@ -70,7 +70,7 @@ def test_upgrade_signal_quorum_flow():
         assert node2.app.app_version == 3
         assert node2.app.upgrade.should_upgrade() is None
     finally:
-        app_versions._ACCEPTED.pop(3, None)
+        app_versions.unregister_version(3)
 
 
 def test_upgrade_quorum_not_met():

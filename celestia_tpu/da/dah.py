@@ -41,16 +41,33 @@ class ExtendedDataSquare:
     original data square.
     """
 
-    def __init__(self, shares: np.ndarray):
-        shares = np.asarray(shares, dtype=np.uint8)
+    def __init__(self, shares):
+        # Accepts a host array OR a device (jax) array.  A device-resident
+        # EDS stays on the device until something actually reads the share
+        # bytes (proof generation, gossip): PrepareProposal/ProcessProposal
+        # only consume the roots, so the ~8-33 MiB device->host transfer
+        # drops out of the block hot path (SURVEY §7 hard part c).
+        if isinstance(shares, (list, tuple)) or not hasattr(shares, "shape"):
+            shares = np.asarray(shares, dtype=np.uint8)
+        elif isinstance(shares, np.ndarray):
+            shares = np.asarray(shares, dtype=np.uint8)
+        elif shares.dtype != np.uint8:  # device array with wrong dtype
+            raise ValueError(f"EDS shares must be uint8, got {shares.dtype}")
         n = shares.shape[0]
         if shares.shape != (n, n, SHARE_SIZE) or n % 2 or not is_power_of_two(n // 2):
             raise ValueError(f"invalid EDS shape {shares.shape}")
-        self.shares = shares
+        self._shares = shares
+
+    @property
+    def shares(self) -> np.ndarray:
+        if not isinstance(self._shares, np.ndarray):
+            self._shares = np.asarray(self._shares).astype(np.uint8, copy=False)
+        return self._shares
 
     @property
     def width(self) -> int:
-        return self.shares.shape[0]
+        # shape is metadata — never forces a device->host transfer
+        return self._shares.shape[0]
 
     @property
     def square_size(self) -> int:
@@ -188,7 +205,7 @@ def extend_and_header(
     eds_d, row_roots, col_roots, data_root = _extend_and_roots_fn(k)(
         jnp.asarray(square)
     )
-    eds = ExtendedDataSquare(np.asarray(eds_d))
+    eds = ExtendedDataSquare(eds_d)  # stays on device until shares are read
     rr = np.asarray(row_roots)
     cc = np.asarray(col_roots)
     dah = DataAvailabilityHeader(

@@ -134,6 +134,41 @@ def decode_matrix(known_points: np.ndarray, k: int) -> np.ndarray:
     return lagrange_matrix(known, np.arange(2 * k, dtype=np.uint8))
 
 
+def decode_matrices_batch(known_batch: np.ndarray, k: int) -> np.ndarray:
+    """Per-axis decode matrices, vectorized: known_batch uint8[n, k] (each
+    row k distinct points) -> D uint8[n, 2k, k].
+
+    The fully-vectorized form of :func:`decode_matrix` over a batch of
+    axes — repair of a DAS-withheld square needs one matrix per axis (every
+    axis can have a different availability mask), and building them one
+    Python call at a time dominates repair time at k=128.
+    """
+    src = np.asarray(known_batch, dtype=np.uint8)
+    n = src.shape[0]
+    if src.shape != (n, k):
+        raise ValueError(f"known_batch must be (n, {k}), got {src.shape}")
+    dst = np.arange(2 * k, dtype=np.uint8)
+    # denominators: denom_log[b, j] = sum_{m != j} log(src_j ^ src_m)
+    diff_ss = src[:, None, :] ^ src[:, :, None]  # [b, j, m]
+    diag = np.arange(k)
+    diff_ss[:, diag, diag] = 1  # neutral in the log-sum
+    denom_log = GF_LOG[diff_ss.astype(np.int32)].sum(axis=2) % _ORDER  # [b, j]
+    # numerators: for every dst_i, prod_{m != j} (dst_i ^ src_m)
+    diff_ds = dst[None, :, None] ^ src[:, None, :]  # [b, i, m]
+    zero_mask = diff_ds == 0  # dst_i == src_m (at most one m per (b, i))
+    safe = np.where(zero_mask, 1, diff_ds)
+    log_all = GF_LOG[safe.astype(np.int32)]  # [b, i, m]
+    total_log = log_all.sum(axis=2)  # [b, i]
+    has_zero = zero_mask.any(axis=2)  # [b, i]
+    num_log = (total_log[:, :, None] - log_all) % _ORDER  # [b, i, j]
+    lagrange = GF_EXP[(num_log - denom_log[:, None, :]) % _ORDER]
+    # rows where dst coincides with a src point are unit rows — zero_mask
+    # is exactly that one-hot (src points are distinct per axis)
+    return np.where(
+        has_zero[:, :, None], zero_mask.astype(np.uint8), lagrange
+    ).astype(np.uint8)
+
+
 # --- GF(2) bit-expansion ----------------------------------------------------
 #
 # Multiplication by a constant c in GF(2^8) is GF(2)-linear on the bits of the

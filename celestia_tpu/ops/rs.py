@@ -144,6 +144,33 @@ def decode_axes(rows: np.ndarray, known_points: np.ndarray) -> np.ndarray:
     return np.asarray(out)[:n]
 
 
+def _gf_matmul_axes_host(D: np.ndarray, X: np.ndarray) -> np.ndarray:
+    """out[i] = D[i] x X[i] over GF(256): threaded native C++ when
+    available, vectorized numpy log-table fallback otherwise."""
+    from celestia_tpu.utils import native
+
+    if native.available():
+        return native.gf_matmul_axes(D, X)
+    n, R, k = D.shape
+    B = X.shape[2]
+    out = np.zeros((n, R, B), dtype=np.uint8)
+    logX = gf256.GF_LOG[X.astype(np.int32)]  # [n, k, B]
+    for i in range(n):
+        acc = out[i]
+        for j in range(k):
+            col = D[i, :, j]
+            nz = col != 0
+            if not nz.any():
+                continue
+            prod = gf256.GF_EXP[
+                (gf256.GF_LOG[col[nz].astype(np.int32)][:, None] + logX[i, j][None, :])
+                % 255
+            ].astype(np.uint8)
+            prod[:, X[i, j] == 0] = 0
+            acc[nz] ^= prod
+    return out
+
+
 class ByzantineError(ValueError):
     """The available shares are not a consistent Reed-Solomon codeword
     (rsmt2d ErrByzantine parity): a malicious proposer published shares that
@@ -197,21 +224,27 @@ def repair_square(
             solvable = np.nonzero((counts >= k) & (counts < n2))[0]
             if len(solvable) == 0:
                 continue
-            # Group axes by identical availability mask (typical DAS
-            # withholding patterns produce one or two groups).
-            groups: dict = {}
-            for i in solvable:
-                key = tuple(np.nonzero(mask[i])[0][:k])
-                groups.setdefault(key, []).append(i)
-            for key, idxs in groups.items():
-                rows = data[np.asarray(idxs)]
-                decoded = decode_axes(rows, np.asarray(key))
-                if axis == 0:
-                    eds[np.asarray(idxs)] = decoded
-                    avail[np.asarray(idxs)] = True
-                else:
-                    eds[:, np.asarray(idxs)] = decoded.transpose(1, 0, 2)
-                    avail[:, np.asarray(idxs)] = True
+            # Decode ALL solvable axes in one batched host call: under a
+            # random DAS withholding pattern every axis carries a distinct
+            # availability mask, so per-mask grouping degenerates to one
+            # dispatch per axis — hundreds of device round-trips.  Instead
+            # build one Lagrange decode matrix per axis (vectorized) and
+            # run one threaded native GF matmul over the whole batch.
+            idxs = solvable
+            # first k available positions per axis: stable argsort of ~mask
+            order = np.argsort(~mask[idxs], axis=1, kind="stable")
+            known_idx = np.sort(order[:, :k], axis=1)  # [n_axes, k]
+            D = gf256.decode_matrices_batch(known_idx.astype(np.uint8), k)
+            X = np.take_along_axis(
+                data[idxs], known_idx[:, :, None], axis=1
+            )  # [n_axes, k, B]
+            decoded = _gf_matmul_axes_host(D, X)  # [n_axes, 2k, B]
+            if axis == 0:
+                eds[idxs] = decoded
+                avail[idxs] = True
+            else:
+                eds[:, idxs] = decoded.transpose(1, 0, 2)
+                avail[:, idxs] = True
             progress = True
         if not progress:
             raise ValueError(
@@ -223,7 +256,25 @@ def repair_square(
     # agree with it.  (rsmt2d returns ErrByzantine from Repair here.)
     orig_avail = np.asarray(available, dtype=bool)
     provided = np.array(original_eds, dtype=np.uint8, copy=False)
-    recomputed = np.asarray(extend_square(eds[:k, :k]))
+    # Repair is a DAS/light-client operation: verify on the host (threaded
+    # native pipeline, bit-identical to the device kernels) so repairing a
+    # square never requires an accelerator or pays a cold device compile;
+    # the device path remains the fallback where the native lib is absent.
+    from celestia_tpu.utils import native as _native
+
+    use_native = _native.available()
+    need_roots = row_roots is not None or col_roots is not None
+    native_roots = None
+    if use_native and need_roots:
+        # one threaded pass computes both the re-extension and the axis
+        # roots needed for the commitment check below
+        recomputed, native_roots, _ = _native.extend_block_cpu(
+            eds[:k, :k], nthreads=0
+        )
+    elif use_native:
+        recomputed = _native.rs_extend_square(eds[:k, :k])
+    else:
+        recomputed = np.asarray(extend_square(eds[:k, :k]))
     if not np.array_equal(eds, recomputed):
         bad = np.nonzero((eds != recomputed).any(axis=2))
         raise ByzantineError(
@@ -237,9 +288,14 @@ def repair_square(
             f"cells {list(zip(*bad))[:8]}"
         )
     if row_roots is not None or col_roots is not None:
-        from celestia_tpu.ops import nmt as nmt_ops
+        if native_roots is not None:
+            # eds == recomputed at this point, so the pipeline's roots ARE
+            # the repaired square's roots
+            roots = native_roots.reshape(2, n2, 90)
+        else:
+            from celestia_tpu.ops import nmt as nmt_ops
 
-        roots = np.asarray(nmt_ops.eds_nmt_roots(eds))
+            roots = np.asarray(nmt_ops.eds_nmt_roots(eds))
         for name, axis_roots, got in (
             ("row", row_roots, roots[0]),
             ("col", col_roots, roots[1]),

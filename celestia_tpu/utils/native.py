@@ -59,6 +59,10 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.sha256_batch.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
     lib.nmt_root.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
     lib.eds_nmt_roots.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
+    lib.gf_matmul_axes.argtypes = [
+        u8p, u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+    ]
     lib.extend_block_cpu.argtypes = [
         u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p, u8p, u8p,
     ]
@@ -140,6 +144,23 @@ def extend_block_cpu(square: np.ndarray, nthreads: int = 0):
         _ptr(data_root),
     )
     return eds, roots, data_root
+
+
+def gf_matmul_axes(D: np.ndarray, X: np.ndarray, nthreads: int = 0) -> np.ndarray:
+    """Per-axis GF(256) matmul: D uint8[n, R, k] x X uint8[n, k, B] ->
+    uint8[n, R, B] (the repair decode step, threaded)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    D = np.ascontiguousarray(D, dtype=np.uint8)
+    X = np.ascontiguousarray(X, dtype=np.uint8)
+    n, R, k = D.shape
+    B = X.shape[2]
+    if X.shape != (n, k, B):
+        raise ValueError(f"X must be ({n}, {k}, B), got {X.shape}")
+    out = np.zeros((n, R, B), dtype=np.uint8)
+    lib.gf_matmul_axes(_ptr(D), _ptr(X), _ptr(out), n, R, k, B, nthreads)
+    return out
 
 
 def ecmul_double(u1_be: bytes, u2_be: bytes, pub33: bytes):

@@ -248,6 +248,45 @@ void eds_nmt_roots(const uint8_t* eds, int k, int B, uint8_t* out) {
     delete[] leaves;
 }
 
+// Batched per-axis GF(256) matmul: out[i] = D[i] (rows_out x k) * X[i]
+// (k x B), striped across nthreads threads.  The decode step of
+// rsmt2d.Repair-style reconstruction: one matrix per axis (every axis can
+// carry a different availability mask).
+void gf_matmul_axes(const uint8_t* D, const uint8_t* X, uint8_t* out, int n,
+                    int rows_out, int k, int B, int nthreads) {
+    gf_init();
+    if (nthreads <= 0) {
+        nthreads = (int)std::thread::hardware_concurrency();
+        if (nthreads <= 0) nthreads = 1;
+    }
+    if (nthreads > n) nthreads = n > 0 ? n : 1;
+    auto work = [=](int t) {
+        for (int i = t; i < n; i += nthreads) {
+            const uint8_t* Di = D + (size_t)i * rows_out * k;
+            const uint8_t* Xi = X + (size_t)i * k * B;
+            uint8_t* Oi = out + (size_t)i * rows_out * B;
+            memset(Oi, 0, (size_t)rows_out * B);
+            for (int r = 0; r < rows_out; r++) {
+                uint8_t* orow = Oi + (size_t)r * B;
+                for (int j = 0; j < k; j++) {
+                    const uint8_t c = Di[r * k + j];
+                    if (c == 0) continue;
+                    const uint8_t* mul = MUL[c];
+                    const uint8_t* in = Xi + (size_t)j * B;
+                    for (int b = 0; b < B; b++) orow[b] ^= mul[in[b]];
+                }
+            }
+        }
+    };
+    if (nthreads == 1) {
+        work(0);
+    } else {
+        std::vector<std::thread> ts;
+        for (int t = 0; t < nthreads; t++) ts.emplace_back(work, t);
+        for (auto& th : ts) th.join();
+    }
+}
+
 // ---------------------------------------------------------------------------
 // Threaded full CPU pipeline: extend + all NMT axis roots + data root.
 // This is the honest CPU comparison leg for bench.py (the role Leopard-RS +

@@ -47,9 +47,8 @@ class ExtendedDataSquare:
         # bytes (proof generation, gossip): PrepareProposal/ProcessProposal
         # only consume the roots, so the ~8-33 MiB device->host transfer
         # drops out of the block hot path (SURVEY §7 hard part c).
-        if isinstance(shares, (list, tuple)) or not hasattr(shares, "shape"):
-            shares = np.asarray(shares, dtype=np.uint8)
-        elif isinstance(shares, np.ndarray):
+        if isinstance(shares, np.ndarray) or not hasattr(shares, "shape"):
+            # host-coercible input (ndarray, list, tuple, ...)
             shares = np.asarray(shares, dtype=np.uint8)
         elif shares.dtype != np.uint8:  # device array with wrong dtype
             raise ValueError(f"EDS shares must be uint8, got {shares.dtype}")

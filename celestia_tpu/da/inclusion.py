@@ -9,7 +9,12 @@ subtrees, whose widths form a merkle mountain range bounded by
 SubtreeWidth(blob) — making the commitment independent of the square size
 and equal to the subtree roots that appear in the row NMTs.
 
-Subtree NMT roots are computed on device, batched by mountain width.
+Subtree NMT roots are computed on the HOST (native C++ when available,
+hashlib otherwise): a blob's mountains are tiny trees (<= 64 leaves), and
+per-blob device dispatches would cost a round-trip + a shape-specific
+compile each — hundreds of them per full-square proposal, dominating
+PrepareProposal/ProcessProposal wall time.  The device keeps the big
+batched work (the 4k axis trees); commitments are host work.
 """
 
 from __future__ import annotations
@@ -17,8 +22,6 @@ from __future__ import annotations
 from typing import List
 
 import numpy as np
-import jax
-import jax.numpy as jnp
 
 from celestia_tpu.appconsts import (
     DEFAULT_SUBTREE_ROOT_THRESHOLD,
@@ -29,6 +32,7 @@ from celestia_tpu.da.blob import Blob
 from celestia_tpu.da.shares import shares_to_array, split_blob_into_shares
 from celestia_tpu.da.square import subtree_width
 from celestia_tpu.ops import nmt as nmt_ops
+from celestia_tpu.utils import native
 
 
 def merkle_mountain_range_sizes(total: int, max_tree_size: int) -> List[int]:
@@ -46,10 +50,17 @@ def merkle_mountain_range_sizes(total: int, max_tree_size: int) -> List[int]:
     return sizes
 
 
-@jax.jit
-def _subtree_roots(leaves: jnp.ndarray) -> jnp.ndarray:
-    """uint8[n_trees, width, 541] -> uint8[n_trees, 90]."""
-    return nmt_ops.nmt_roots(leaves)
+def _nmt_root_host(leaves: np.ndarray) -> bytes:
+    """Root of one small NMT on the host: native C++ or hashlib."""
+    if native.available():
+        return native.nmt_root(leaves).tobytes()
+    level = [nmt_ops.leaf_digest_np(leaves[i].tobytes()) for i in range(len(leaves))]
+    while len(level) > 1:
+        level = [
+            nmt_ops.combine_digests_np(level[2 * i], level[2 * i + 1])
+            for i in range(len(level) // 2)
+        ]
+    return level[0]
 
 
 def create_commitment(
@@ -65,22 +76,14 @@ def create_commitment(
     ns = np.broadcast_to(
         np.frombuffer(blob.namespace.raw, dtype=np.uint8), (n, NAMESPACE_SIZE)
     )
-    leaves = np.concatenate([ns, arr], axis=1)  # (n, 541)
-    # batch subtree roots by mountain size
-    roots: List[bytes] = [b""] * len(sizes)
+    leaves = np.ascontiguousarray(
+        np.concatenate([ns, arr], axis=1)
+    )  # (n, 541)
+    roots: List[bytes] = []
     offset = 0
-    offsets = []
     for s in sizes:
-        offsets.append(offset)
+        roots.append(_nmt_root_host(leaves[offset : offset + s]))
         offset += s
-    by_size = {}
-    for i, s in enumerate(sizes):
-        by_size.setdefault(s, []).append(i)
-    for s, idxs in by_size.items():
-        batch = np.stack([leaves[offsets[i] : offsets[i] + s] for i in idxs])
-        out = np.asarray(_subtree_roots(jnp.asarray(batch)))
-        for j, i in enumerate(idxs):
-            roots[i] = out[j].tobytes()
     return nmt_ops.rfc6962_root_np(roots).tobytes()
 
 

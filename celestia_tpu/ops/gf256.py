@@ -147,6 +147,11 @@ def decode_matrices_batch(known_batch: np.ndarray, k: int) -> np.ndarray:
     n = src.shape[0]
     if src.shape != (n, k):
         raise ValueError(f"known_batch must be (n, {k}), got {src.shape}")
+    # consensus-critical math must fail loud: a repeated point would turn
+    # the log-domain denominators into silent garbage
+    sorted_src = np.sort(src, axis=1)
+    if k > 1 and (sorted_src[:, 1:] == sorted_src[:, :-1]).any():
+        raise ValueError("source points must be distinct within each axis")
     dst = np.arange(2 * k, dtype=np.uint8)
     # denominators: denom_log[b, j] = sum_{m != j} log(src_j ^ src_m)
     diff_ss = src[:, None, :] ^ src[:, :, None]  # [b, j, m]

@@ -109,41 +109,6 @@ def extend_squares_batched(squares) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
-def _apply_decode(known: jnp.ndarray, Db: jnp.ndarray) -> jnp.ndarray:
-    """known uint8[n, k, B] + bit decode matrix int8[16k, 8k] -> uint8[n, 2k, B].
-
-    One compiled executable per (n, k, B) shape; the per-availability-mask
-    decode matrix is a runtime argument, so arbitrary withholding patterns
-    never trigger recompilation.
-    """
-    bits = unpack_bits(known)  # (n, 8k, B)
-    out_bits = matmul_gf2(Db, bits)  # (n, 16k, B)
-    return pack_bits(out_bits)  # (n, 2k, B)
-
-
-def decode_axes(rows: np.ndarray, known_points: np.ndarray) -> np.ndarray:
-    """Reconstruct full 2k-long axes from k known positions.
-
-    rows: uint8[n, 2k, B] with valid data at ``known_points`` (k indexes);
-    returns uint8[n, 2k, B] fully populated.
-    """
-    rows = np.asarray(rows, dtype=np.uint8)
-    k = rows.shape[1] // 2
-    known_idx = np.asarray(known_points, dtype=np.int64)
-    if len(known_idx) != k:
-        raise ValueError(f"need exactly {k} known points, got {len(known_idx)}")
-    D = gf256.decode_matrix(known_idx.astype(np.uint8), k)  # (2k, k) GF(256)
-    Db = jnp.asarray(gf256.bit_expand_matrix(D))  # (16k, 8k) int8
-    # pad the batch to a power-of-two bucket to bound compilation count
-    n = rows.shape[0]
-    n_pad = 1 << max(n - 1, 0).bit_length()
-    known = np.zeros((n_pad, k, rows.shape[2]), dtype=np.uint8)
-    known[:n] = rows[:, known_idx, :]
-    out = _apply_decode(jnp.asarray(known), Db)
-    return np.asarray(out)[:n]
-
-
 def _gf_matmul_axes_host(D: np.ndarray, X: np.ndarray) -> np.ndarray:
     """out[i] = D[i] x X[i] over GF(256): threaded native C++ when
     available, vectorized numpy log-table fallback otherwise."""

@@ -146,6 +146,21 @@ def extend_block_cpu(square: np.ndarray, nthreads: int = 0):
     return eds, roots, data_root
 
 
+def nmt_root(leaves: np.ndarray) -> np.ndarray:
+    """Root of one NMT whose leaves are ns-prefixed payloads.
+
+    leaves: uint8[n, leaf_len] with n a power of two -> uint8[90].
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    leaves = np.ascontiguousarray(leaves, dtype=np.uint8)
+    n, leaf_len = leaves.shape
+    out = np.zeros(90, dtype=np.uint8)
+    lib.nmt_root(_ptr(leaves), n, leaf_len, _ptr(out))
+    return out
+
+
 def gf_matmul_axes(D: np.ndarray, X: np.ndarray, nthreads: int = 0) -> np.ndarray:
     """Per-axis GF(256) matmul: D uint8[n, R, k] x X uint8[n, k, B] ->
     uint8[n, R, B] (the repair decode step, threaded)."""

@@ -320,6 +320,40 @@ def cmd_snapshot(args) -> int:
     return 0
 
 
+def cmd_txsim(args) -> int:
+    """Load generator against a running node (test/cmd/txsim parity)."""
+    from celestia_tpu.client.signer import Signer
+    from celestia_tpu.node import txsim
+
+    node = _remote(args)
+    master = Signer(node, _load_key(_home(args), getattr(args, "from_key")))
+    sequences = []
+    for _ in range(args.blob):
+        sequences.append(
+            txsim.BlobSequence(size_max=args.blob_size_max)
+        )
+    for _ in range(args.send):
+        sequences.append(txsim.SendSequence())
+    if not sequences:
+        raise SystemExit("nothing to do: pass --blob N and/or --send N")
+    results = txsim.run_remote(
+        node, master, sequences,
+        iterations=args.iterations, seed=args.seed, funding=args.funding,
+    )
+    ok = sum(1 for r in results if r.get("code") == 0)
+    print(
+        json.dumps(
+            {
+                "submitted": len(results),
+                "succeeded": ok,
+                "failed": len(results) - ok,
+                "final_height": node.height,
+            }
+        )
+    )
+    return 0 if ok == len(results) else 1
+
+
 def cmd_blocktime(args) -> int:
     """Average block interval over a height range (tools/blocktime)."""
     node = _remote(args)
@@ -415,6 +449,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--timeout", type=float, default=120.0,
                     help="per-RPC timeout in seconds")
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("txsim", help="transaction load generator")
+    sp.add_argument("--node", default="127.0.0.1:9090")
+    sp.add_argument("--timeout", type=float, default=120.0,
+                    help="per-RPC timeout in seconds")
+    sp.add_argument("--from", dest="from_key", required=True,
+                    help="master key (funds the sub-accounts)")
+    sp.add_argument("--blob", type=int, default=1, help="blob sequences")
+    sp.add_argument("--send", type=int, default=0, help="send sequences")
+    sp.add_argument("--iterations", type=int, default=10)
+    sp.add_argument("--blob-size-max", type=int, default=10_000)
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--funding", type=int, default=10**9)
+    sp.set_defaults(fn=cmd_txsim)
 
     sp = sub.add_parser("snapshot", help="manage state-sync snapshots")
     ss = sp.add_subparsers(dest="snap_cmd", required=True)

@@ -363,6 +363,11 @@ class TestNode:
             }
         if path == "custom/params/param":
             return self.app.params.get(data["subspace"], data["key"])
+        if path == "custom/staking/validators":
+            return [
+                {"operator": v.operator.hex(), "power": v.power}
+                for v in self.app.staking.bonded_validators()
+            ]
         if path == "custom/upgrade/status":
             tally = self.app.upgrade.tally_voting_power(self.app.app_version + 1)
             return {

@@ -79,36 +79,31 @@ class StakeSequence(Sequence):
     amount: int = 1_000_000
 
     def next(self) -> Optional[dict]:
-        validators = self.signer.node.app.staking.bonded_validators()
+        # transport-agnostic: the validators query route works both
+        # in-process and over gRPC (RemoteNode.abci_query)
+        validators = self.signer.node.abci_query("custom/staking/validators", {})
         if not validators:
             return None
         val = validators[int(self.rng.integers(len(validators)))]
         res = self.signer.submit_tx(
-            [MsgDelegate(self.signer.address, val.operator, self.amount)]
+            [MsgDelegate(self.signer.address, bytes.fromhex(val["operator"]), self.amount)]
         )
         return {"type": "stake", "code": res.code, "log": res.log, "height": res.height}
 
 
-def run(
-    node,
+def _drive(
     sequences: TypingSequence[Sequence],
-    iterations: int = 10,
-    seed: int = 0,
-    funding: int = 10**12,
+    signers: List[Signer],
+    iterations: int,
+    seed: int,
 ) -> List[dict]:
-    """Drive all sequences round-robin for ``iterations`` rounds
-    (run.go:31-115; the reference runs each sequence in a goroutine — here
-    rounds interleave deterministically, which exercises the same mempool /
+    """The round-robin drive loop shared by run/run_remote (run.go:31-115;
+    the reference runs each sequence in a goroutine — here rounds
+    interleave deterministically, which exercises the same mempool /
     sequence contention paths reproducibly)."""
     results: List[dict] = []
     for i, seq in enumerate(sequences):
-        key = PrivateKey.from_seed(b"txsim-%d" % i + seed.to_bytes(4, "big"))
-        addr = key.public_key().address()
-        # fund from the node's faucet (validator account)
-        node.app.bank.mint(addr, funding)
-        node.app.accounts.get_or_create(addr)
-        signer = Signer(node, key)
-        seq.init(signer, np.random.default_rng(seed * 1000 + i))
+        seq.init(signers[i], np.random.default_rng(seed * 1000 + i))
     active = list(sequences)
     for _ in range(iterations):
         still_active = []
@@ -122,3 +117,46 @@ def run(
         if not active:
             break
     return results
+
+
+def run_remote(
+    node,
+    master_signer: "Signer",
+    sequences: TypingSequence[Sequence],
+    iterations: int = 10,
+    seed: int = 0,
+    funding: int = 10**9,
+) -> List[dict]:
+    """txsim against a REMOTE node (test/cmd/txsim/cli.go parity): the
+    master key funds one derived sub-account per sequence over the network
+    (the reference's master-account funding flow), then sequences run
+    round-robin."""
+    signers = []
+    for i in range(len(sequences)):
+        key = PrivateKey.from_seed(b"txsim-sub-%d" % i + seed.to_bytes(4, "big"))
+        res = master_signer.submit_tx(
+            [MsgSend(master_signer.address, key.public_key().address(), funding)]
+        )
+        if res.code != 0:
+            raise RuntimeError(f"funding sub-account {i} failed: {res.log}")
+        signers.append(Signer(node, key))
+    return _drive(sequences, signers, iterations, seed)
+
+
+def run(
+    node,
+    sequences: TypingSequence[Sequence],
+    iterations: int = 10,
+    seed: int = 0,
+    funding: int = 10**12,
+) -> List[dict]:
+    """txsim against an in-process node: sub-accounts are funded straight
+    from the faucet (minted), then sequences run round-robin."""
+    signers = []
+    for i in range(len(sequences)):
+        key = PrivateKey.from_seed(b"txsim-%d" % i + seed.to_bytes(4, "big"))
+        addr = key.public_key().address()
+        node.app.bank.mint(addr, funding)
+        node.app.accounts.get_or_create(addr)
+        signers.append(Signer(node, key))
+    return _drive(sequences, signers, iterations, seed)

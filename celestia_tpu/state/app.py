@@ -447,6 +447,11 @@ class App:
     def _execute_msg(self, msg: Msg, gas_meter: GasMeter) -> dict:
         if isinstance(msg, MsgSend):
             self.bank.send(msg.from_addr, msg.to_addr, msg.amount)
+            # a recipient seeing funds for the first time gets its auth
+            # account here, deterministically in-block (the SDK's bank ->
+            # auth.NewAccount behavior): clients can then query a stable
+            # account number before signing their first tx
+            self.accounts.get_or_create(msg.to_addr)
             return {"type": "transfer", "amount": msg.amount}
         if isinstance(msg, MsgPayForBlobs):
             return self.blob.pay_for_blobs(msg, gas_meter)

@@ -84,8 +84,12 @@ class _OverlayLayer:
 class KVStore:
     """A single namespaced store view."""
 
-    def __init__(self, layer):
+    def __init__(self, layer, name: str = "", tracer_ref=None):
         self._layer = layer
+        self._name = name
+        # shared mutable holder [callable | None] owned by the MultiStore —
+        # installing a tracer after KVStores were handed out still traces
+        self._tracer_ref = tracer_ref if tracer_ref is not None else [None]
 
     def get(self, key: bytes) -> Optional[bytes]:
         return self._layer.get(key)
@@ -93,9 +97,15 @@ class KVStore:
     def set(self, key: bytes, value: bytes) -> None:
         if not isinstance(key, bytes) or not isinstance(value, bytes):
             raise TypeError("keys and values must be bytes")
+        tracer = self._tracer_ref[0]
+        if tracer is not None:
+            tracer("write", self._name, key, value)
         self._layer.set(key, value)
 
     def delete(self, key: bytes) -> None:
+        tracer = self._tracer_ref[0]
+        if tracer is not None:
+            tracer("delete", self._name, key, None)
         self._layer.delete(key)
 
     def has(self, key: bytes) -> bool:
@@ -119,11 +129,19 @@ class MultiStore:
         self._versions: List[Tuple[int, Dict[str, Dict[bytes, bytes]], bytes]] = []
         self._last_height = 0
         self._parent: Optional["MultiStore"] = None
+        self._tracer_ref: List[Optional[object]] = [None]
+
+    def set_tracer(self, tracer) -> None:
+        """Install a write tracer: tracer(op, store_name, key, value) fires
+        on every set/delete through this store's views (the reference's
+        SetCommitMultiStoreTracer role, app/app.go:243).  Pass None to
+        remove.  Branches created AFTER installation inherit it."""
+        self._tracer_ref[0] = tracer
 
     def store(self, name: str) -> KVStore:
         if name not in self._layers:
             raise KeyError(f"unknown store {name!r}")
-        return KVStore(self._layers[name])
+        return KVStore(self._layers[name], name, self._tracer_ref)
 
     @property
     def store_names(self) -> List[str]:
@@ -144,6 +162,7 @@ class MultiStore:
         ms._versions = []
         ms._last_height = self._last_height
         ms._parent = self
+        ms._tracer_ref = self._tracer_ref  # branches trace through the root
         return ms
 
     def write_back(self, branched: "MultiStore") -> None:

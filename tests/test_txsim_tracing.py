@@ -1,0 +1,130 @@
+"""txsim over the network + store tracing.
+
+- run_remote: the reference txsim CLI shape (test/cmd/txsim/cli.go,
+  test/txsim/run.go): a master account funds derived sub-accounts over the
+  network, then sequences drive load against the node's gRPC service.
+- store tracing: SetCommitMultiStoreTracer parity (app/app.go:243) — every
+  write through the multistore is observable with its store name and key.
+"""
+
+import numpy as np
+
+from celestia_tpu.client.remote import RemoteNode
+from celestia_tpu.client.signer import Signer
+from celestia_tpu.node import txsim
+from celestia_tpu.node.server import NodeServer
+from celestia_tpu.node.testnode import TestNode
+from celestia_tpu.state.store import MultiStore
+from celestia_tpu.utils.secp256k1 import PrivateKey
+
+
+def test_txsim_remote_blob_and_send():
+    master = PrivateKey.from_seed(b"txsim-master")
+    node = TestNode(
+        funded_accounts=[(master, 10**13)], auto_produce=False
+    )
+    from celestia_tpu.da import dah as dah_mod
+
+    for k in (1, 2, 4):
+        dah_mod.extend_and_header(np.zeros((k, k, 512), dtype=np.uint8))
+    with NodeServer(node, block_interval_s=0.1) as server:
+        remote = RemoteNode(server.address, timeout_s=120.0)
+        signer = Signer(remote, master)
+        results = txsim.run_remote(
+            remote,
+            signer,
+            [txsim.BlobSequence(size_max=2000), txsim.SendSequence()],
+            iterations=3,
+            funding=10**9,
+        )
+        remote.close()
+    assert len(results) == 6
+    assert all(r["code"] == 0 for r in results), [
+        r for r in results if r["code"]
+    ]
+    kinds = {r["type"] for r in results}
+    assert kinds == {"blob", "send"}
+    # load actually landed in blocks
+    assert node.height > 1
+    total_txs = sum(len(b.txs) for b in node.blocks)
+    assert total_txs >= 8  # 2 funding sends + 6 sequence txs
+
+
+def test_cli_txsim_command(tmp_path):
+    """The celestia-tpu txsim command end-to-end against a served node."""
+    import json as _json
+
+    from celestia_tpu.cli import main
+
+    master = PrivateKey.from_seed(b"cli-txsim-master")
+    home = tmp_path / "home"
+    kd = home / "keyring"
+    kd.mkdir(parents=True)
+    (kd / "master.json").write_text(
+        _json.dumps(
+            {
+                "priv": f"{master.d:064x}",
+                "address": master.public_key().address().hex(),
+            }
+        )
+    )
+    node = TestNode(funded_accounts=[(master, 10**13)], auto_produce=False)
+    from celestia_tpu.da import dah as dah_mod
+
+    for k in (1, 2, 4):
+        dah_mod.extend_and_header(np.zeros((k, k, 512), dtype=np.uint8))
+    with NodeServer(node, block_interval_s=0.1) as server:
+        rc = main(
+            [
+                "--home", str(home),
+                "txsim",
+                "--node", server.address,
+                "--from", "master",
+                "--blob", "1",
+                "--send", "1",
+                "--iterations", "2",
+                "--blob-size-max", "1500",
+            ]
+        )
+    assert rc == 0
+
+
+def test_store_tracer_observes_writes():
+    ms = MultiStore(["bank", "auth"])
+    events = []
+    ms.set_tracer(lambda op, store, key, value: events.append((op, store, key)))
+    ms.store("bank").set(b"k1", b"v1")
+    ms.store("auth").delete(b"k2")
+    # branches created after installation trace through to the same sink
+    branch = ms.branch()
+    branch.store("bank").set(b"k3", b"v3")
+    assert events == [
+        ("write", "bank", b"k1"),
+        ("delete", "auth", b"k2"),
+        ("write", "bank", b"k3"),
+    ]
+    ms.set_tracer(None)
+    ms.store("bank").set(b"k4", b"v4")
+    assert len(events) == 3
+
+
+def test_tracer_can_follow_a_block():
+    """Trace every store write made by one block's execution — the
+    debugging workflow SetCommitMultiStoreTracer exists for."""
+    alice = PrivateKey.from_seed(b"trace-alice")
+    node = TestNode(funded_accounts=[(alice, 10**12)])
+    signer = Signer(node, alice)
+    writes = []
+    node.app.store.set_tracer(
+        lambda op, store, key, value: writes.append((op, store))
+    )
+    from celestia_tpu.state.tx import MsgSend
+
+    res = signer.submit_tx(
+        [MsgSend(signer.address, b"\x11" * 20, 1000)]
+    )
+    node.app.store.set_tracer(None)
+    assert res.code == 0
+    stores_touched = {s for _, s in writes}
+    # fee deduction + transfer touch bank; sequence bump touches auth
+    assert "bank" in stores_touched and "auth" in stores_touched

@@ -112,6 +112,15 @@ def cmd_init(args) -> int:
 
 
 def cmd_start(args) -> int:
+    # test/CI hook: force the jax platform before first device use (the
+    # JAX_PLATFORMS env var alone is overridden by sitecustomize on some
+    # hosts) — lets multi-process harnesses run nodes on the CPU backend
+    platform = os.environ.get("CELESTIA_JAX_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
     from celestia_tpu.node.config import load_config
     from celestia_tpu.node.server import NodeServer
     from celestia_tpu.node.testnode import TestNode
@@ -329,9 +338,13 @@ def cmd_txsim(args) -> int:
     master = Signer(node, _load_key(_home(args), getattr(args, "from_key")))
     sequences = []
     for _ in range(args.blob):
-        sequences.append(
-            txsim.BlobSequence(size_max=args.blob_size_max)
-        )
+        seq = txsim.BlobSequence(size_max=args.blob_size_max)
+        if args.blob_size_max < seq.size_min:
+            raise SystemExit(
+                f"--blob-size-max {args.blob_size_max} is below the minimum "
+                f"blob size {seq.size_min}"
+            )
+        sequences.append(seq)
     for _ in range(args.send):
         sequences.append(txsim.SendSequence())
     if not sequences:

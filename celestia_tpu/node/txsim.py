@@ -131,15 +131,21 @@ def run_remote(
     master key funds one derived sub-account per sequence over the network
     (the reference's master-account funding flow), then sequences run
     round-robin."""
-    signers = []
-    for i in range(len(sequences)):
-        key = PrivateKey.from_seed(b"txsim-sub-%d" % i + seed.to_bytes(4, "big"))
-        res = master_signer.submit_tx(
-            [MsgSend(master_signer.address, key.public_key().address(), funding)]
-        )
-        if res.code != 0:
-            raise RuntimeError(f"funding sub-account {i} failed: {res.log}")
-        signers.append(Signer(node, key))
+    keys = [
+        PrivateKey.from_seed(b"txsim-sub-%d" % i + seed.to_bytes(4, "big"))
+        for i in range(len(sequences))
+    ]
+    # one multi-msg tx funds every sub-account: a single broadcast +
+    # confirmation instead of N round trips
+    res = master_signer.submit_tx(
+        [
+            MsgSend(master_signer.address, key.public_key().address(), funding)
+            for key in keys
+        ]
+    )
+    if res.code != 0:
+        raise RuntimeError(f"funding sub-accounts failed: {res.log}")
+    signers = [Signer(node, key) for key in keys]
     return _drive(sequences, signers, iterations, seed)
 
 

@@ -147,6 +147,11 @@ class App:
     def _set_app_version(self, v: int) -> None:
         self.store.store("meta").set(_APP_VERSION_KEY, v.to_bytes(8, "big"))
 
+    def next_height(self) -> int:
+        """Height the next tx would execute at: the in-flight block during
+        delivery, or the block about to be built during check/propose."""
+        return max(self.block_height, self.store.last_height + 1)
+
     def max_effective_square_size(self) -> int:
         """min(gov cap, hard cap) — square_size.go:9-23."""
         gov = self.blob.gov_max_square_size()
@@ -232,6 +237,7 @@ class App:
                 is_check_tx=True,
                 is_recheck=is_recheck,
                 min_gas_price=self.min_gas_price,
+                height=self.next_height(),
             )
             meter = run_ante(ctx)
             check_state.write_back(branch)
@@ -304,6 +310,7 @@ class App:
                     chain_id=self.chain_id,
                     app_version=self.app_version,
                     sig_ok=sig_ok,
+                    height=self.next_height(),
                 )
                 run_ante(ctx)
                 kept.append(raw)
@@ -361,6 +368,7 @@ class App:
                     chain_id=self.chain_id,
                     app_version=self.app_version,
                     sig_ok=sig_ok,
+                    height=self.next_height(),
                 )
                 run_ante(ctx)
             # strict reconstruction
@@ -417,6 +425,7 @@ class App:
             params=ParamsKeeper(ante_branch.store("params")),
             chain_id=self.chain_id,
             app_version=self.app_version,
+            height=self.next_height(),
         )
         try:
             meter = run_ante(ctx)

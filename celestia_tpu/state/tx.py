@@ -370,6 +370,9 @@ class Tx:
     account_number: int
     memo: str = ""
     signature: bytes = b""
+    # reject inclusion above this height; 0 = no timeout (the SDK's
+    # TxTimeoutHeightDecorator field)
+    timeout_height: int = 0
 
     def body_bytes(self) -> bytes:
         out = bytearray()
@@ -377,6 +380,7 @@ class Tx:
         for m in self.msgs:
             _put_bytes(out, marshal_msg(m))
         _put_bytes(out, self.memo.encode())
+        out += _varint(self.timeout_height)
         return bytes(out)
 
     def auth_bytes(self) -> bytes:
@@ -399,7 +403,7 @@ class Tx:
         sig = priv.sign(self.sign_bytes(chain_id))
         return Tx(
             self.msgs, self.fee, self.pubkey, self.sequence,
-            self.account_number, self.memo, sig,
+            self.account_number, self.memo, sig, self.timeout_height,
         )
 
     def verify_signature(self, chain_id: str) -> bool:
@@ -440,6 +444,7 @@ def unmarshal_tx(raw: bytes) -> Tx:
             raise ValueError("trailing bytes in msg")
         msgs.append(msg)
     memo_b, bpos = _get_bytes(body, bpos)
+    timeout_height, bpos = _read_varint(body, bpos)
     if bpos != len(body):
         raise ValueError("trailing bytes in tx body")
     # auth
@@ -453,5 +458,5 @@ def unmarshal_tx(raw: bytes) -> Tx:
         raise ValueError("trailing bytes in tx auth")
     return Tx(
         tuple(msgs), Fee(fee_amount, gas_limit), pubkey, sequence,
-        account_number, memo_b.decode(), sig,
+        account_number, memo_b.decode(), sig, timeout_height,
     )

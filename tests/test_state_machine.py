@@ -343,3 +343,32 @@ def test_genesis_validator_balance_topup_is_shortfall_only():
     # exactly the shortfall was minted: balance is now 0 after delegating 100
     assert app.bank.balance(addr) == 0
     assert app.staking.validator(addr).tokens == 100
+
+
+def test_timeout_height_decorator():
+    """TxTimeoutHeightDecorator: a tx with a timeout below the inclusion
+    height is refused at CheckTx and at delivery."""
+    from celestia_tpu.client.signer import Signer
+    from celestia_tpu.node.testnode import TestNode
+    from celestia_tpu.state.tx import MsgSend
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+
+    alice = PrivateKey.from_seed(b"timeout-alice")
+    node = TestNode(funded_accounts=[(alice, 10**12)])
+    node.produce_blocks(3)  # height 4
+    signer = Signer(node, alice)
+    sink = b"\x21" * 20
+    # already expired -> CheckTx rejects
+    tx = signer.sign_tx([MsgSend(signer.address, sink, 5)], timeout_height=2)
+    res = node.broadcast_tx(tx.marshal())
+    assert res.code != 0 and "timed out" in res.log
+    # far-future timeout -> accepted and executed
+    res = signer.submit_tx([MsgSend(signer.address, sink, 5)],
+                           timeout_height=100)
+    assert res.code == 0, res.log
+    assert node.app.bank.balance(sink) == 5
+    # timeout at exactly the inclusion height is still valid
+    h = node.height
+    res = signer.submit_tx([MsgSend(signer.address, sink, 7)],
+                           timeout_height=h + 1)
+    assert res.code == 0, res.log

@@ -47,7 +47,7 @@ def test_txsim_remote_blob_and_send():
     # load actually landed in blocks
     assert node.height > 1
     total_txs = sum(len(b.txs) for b in node.blocks)
-    assert total_txs >= 8  # 2 funding sends + 6 sequence txs
+    assert total_txs >= 7  # 1 multi-msg funding tx + 6 sequence txs
 
 
 def test_cli_txsim_command(tmp_path):

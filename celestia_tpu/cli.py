@@ -90,6 +90,12 @@ def cmd_init(args) -> int:
     from celestia_tpu.node.config import init_home
 
     home = _home(args)
+    if args.genesis and args.fund_keyring:
+        raise SystemExit(
+            "--fund-keyring conflicts with --genesis: a shared genesis "
+            "replaces the generated one; add the accounts to the shared "
+            "genesis file instead"
+        )
     extra = []
     if args.fund_keyring:
         for p in sorted(_keyring_dir(home).glob("*.json")):
@@ -99,11 +105,18 @@ def cmd_init(args) -> int:
         home, chain_id=args.chain_id, overwrite=args.overwrite,
         extra_accounts=extra,
     )
+    chain_id = args.chain_id
+    if args.genesis:
+        shared = json.loads(Path(args.genesis).read_text())
+        chain_id = shared.get("chain_id", chain_id)
+        (root / "config" / "genesis.json").write_text(
+            json.dumps(shared, indent=1)
+        )
     print(
         json.dumps(
             {
                 "home": str(root),
-                "chain_id": args.chain_id,
+                "chain_id": chain_id,
                 "funded_accounts": len(extra),
             }
         )
@@ -187,7 +200,8 @@ def cmd_start(args) -> int:
     server = NodeServer(
         node,
         address=cfg.grpc.address,
-        block_interval_s=cfg.consensus.block_interval_s,
+        # validator mode: the coordinator drives consensus; no self-loop
+        block_interval_s=None if args.validator else cfg.consensus.block_interval_s,
     )
     server.start()
     log.info(
@@ -291,6 +305,40 @@ def cmd_query(args) -> int:
 
 def cmd_status(args) -> int:
     print(json.dumps(_remote(args).status()))
+    return 0
+
+
+def cmd_coordinator(args) -> int:
+    from celestia_tpu.client.remote import RemoteNode
+    from celestia_tpu.node.coordinator import PeerValidator, ProcessCoordinator
+
+    peers = [
+        PeerValidator(name=f"val-{i}", client=RemoteNode(addr, timeout_s=args.timeout))
+        for i, addr in enumerate(args.peers.split(","))
+    ]
+    coord = ProcessCoordinator(
+        peers, block_interval_ns=int(args.block_interval * 1e9)
+    )
+    produced = 0
+    while args.blocks == 0 or produced < args.blocks:
+        t0 = time.time()
+        coord.produce_block()
+        blk = coord.blocks[-1]
+        print(
+            json.dumps(
+                {
+                    "height": blk["height"],
+                    "proposer": blk["proposer"],
+                    "txs": blk["n_txs"],
+                    "app_hash": blk["app_hash"].hex()[:16],
+                }
+            ),
+            flush=True,
+        )
+        produced += 1
+        remaining = args.block_interval - (time.time() - t0)
+        if remaining > 0 and (args.blocks == 0 or produced < args.blocks):
+            time.sleep(remaining)
     return 0
 
 
@@ -400,13 +448,34 @@ def build_parser() -> argparse.ArgumentParser:
         "--fund-keyring", type=int, default=0, metavar="UTIA",
         help="fund every key already in the home keyring with this balance",
     )
+    sp.add_argument(
+        "--genesis", default=None, metavar="FILE",
+        help="use this shared genesis.json instead of generating one "
+             "(multi-validator setups: every home gets the same genesis)",
+    )
     sp.set_defaults(fn=cmd_init)
 
     sp = sub.add_parser("start", help="run the node + gRPC service")
     sp.add_argument("--grpc-address", default=None)
     sp.add_argument("--block-interval", type=float, default=None)
     sp.add_argument("--v2-upgrade-height", type=int, default=None)
+    sp.add_argument(
+        "--validator", action="store_true",
+        help="validator mode: no self-production; an external coordinator "
+             "drives consensus through the ConsPrepare/Process/Commit RPCs",
+    )
     sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser(
+        "coordinator", help="drive consensus across validator processes"
+    )
+    sp.add_argument("--peers", required=True,
+                    help="comma-separated validator gRPC addresses")
+    sp.add_argument("--blocks", type=int, default=0,
+                    help="produce N blocks then exit (0 = run forever)")
+    sp.add_argument("--block-interval", type=float, default=1.0)
+    sp.add_argument("--timeout", type=float, default=120.0)
+    sp.set_defaults(fn=cmd_coordinator)
 
     sp = sub.add_parser("keys", help="manage the file keyring")
     ks = sp.add_subparsers(dest="keys_cmd", required=True)

@@ -109,6 +109,43 @@ class RemoteNode:
             raise RemoteError(out.get("log", "query failed"))
         return out["value"]
 
+    # -- consensus surface (used by node/coordinator.py) ----------------
+
+    def cons_prepare(self) -> dict:
+        out = self._call_json("ConsPrepare", {})
+        return {
+            "block_txs": [bytes.fromhex(t) for t in out["block_txs"]],
+            "square_size": out["square_size"],
+            "data_root": bytes.fromhex(out["data_root"]),
+        }
+
+    def cons_process(self, block_txs, square_size: int, data_root: bytes):
+        out = self._call_json(
+            "ConsProcess",
+            {
+                "block_txs": [t.hex() for t in block_txs],
+                "square_size": square_size,
+                "data_root": data_root.hex(),
+            },
+        )
+        return out["accept"], out.get("reason", "")
+
+    def cons_commit(
+        self, block_txs, height: int, time_ns: int, data_root: bytes,
+        square_size: int,
+    ) -> bytes:
+        out = self._call_json(
+            "ConsCommit",
+            {
+                "block_txs": [t.hex() for t in block_txs],
+                "height": height,
+                "time_ns": time_ns,
+                "data_root": data_root.hex(),
+                "square_size": square_size,
+            },
+        )
+        return bytes.fromhex(out["app_hash"])
+
     def wait_for_height(self, h: int, timeout_s: float = 60.0) -> None:
         deadline = time.time() + timeout_s
         while self.height < h:

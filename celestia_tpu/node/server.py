@@ -84,6 +84,7 @@ class NodeService:
                 "app_hash": latest.app_hash.hex() if latest else "",
                 "data_root": latest.data_hash.hex() if latest else "",
                 "time_ns": latest.time_ns if latest else 0,
+                "genesis_time_ns": getattr(node.app, "genesis_time_ns", 0),
             }
         ).encode()
 
@@ -110,6 +111,42 @@ class NodeService:
             }
         ).encode()
 
+    # -- consensus surface (multi-process replication) -----------------
+    #
+    # Driven by an external coordinator (node/coordinator.py): this node
+    # never self-produces in validator mode; the coordinator sequences
+    # prepare -> process votes -> commit across the validator processes.
+
+    def cons_prepare(self, req: bytes, ctx) -> bytes:
+        p = self.node.cons_prepare()
+        return json.dumps(
+            {
+                "block_txs": [t.hex() for t in p["block_txs"]],
+                "square_size": p["square_size"],
+                "data_root": p["data_root"].hex(),
+            }
+        ).encode()
+
+    def cons_process(self, req: bytes, ctx) -> bytes:
+        q = json.loads(req)
+        ok, reason = self.node.cons_process(
+            [bytes.fromhex(t) for t in q["block_txs"]],
+            int(q["square_size"]),
+            bytes.fromhex(q["data_root"]),
+        )
+        return json.dumps({"accept": ok, "reason": reason}).encode()
+
+    def cons_commit(self, req: bytes, ctx) -> bytes:
+        q = json.loads(req)
+        app_hash = self.node.cons_commit(
+            [bytes.fromhex(t) for t in q["block_txs"]],
+            int(q["height"]),
+            int(q["time_ns"]),
+            bytes.fromhex(q["data_root"]),
+            int(q["square_size"]),
+        )
+        return json.dumps({"app_hash": app_hash.hex()}).encode()
+
     def query(self, req: bytes, ctx) -> bytes:
         q = json.loads(req or b"{}")
         path = q.get("path", "")
@@ -131,6 +168,9 @@ class NodeService:
             "Status": self.status,
             "Block": self.block,
             "Query": self.query,
+            "ConsPrepare": self.cons_prepare,
+            "ConsProcess": self.cons_process,
+            "ConsCommit": self.cons_commit,
         }
         method_handlers = {
             name: grpc.unary_unary_rpc_method_handler(

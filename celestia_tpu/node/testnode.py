@@ -230,31 +230,53 @@ class TestNode:
             raise RuntimeError(
                 f"node's own proposal rejected at height {height}: {reason}"
             )
+        return self._apply_block(
+            height, time_ns, proposal.block_txs, proposal.data_root,
+            proposal.square_size, artifacts=proposal,
+        )
+
+    def _apply_block(
+        self,
+        height: int,
+        time_ns: int,
+        block_txs: List[bytes],
+        data_root: bytes,
+        square_size: int,
+        artifacts: Optional[object] = None,
+    ) -> Block:
+        """Shared commit tail: finalize + header/block append, EDS cache,
+        tx index, mempool maintenance, snapshotting.  Used by both the
+        self-producing path and the coordinator's cons_commit."""
         results, _end, app_hash = self.app.finalize_block(
-            proposal.block_txs, height, time_ns, proposal.data_root
+            block_txs, height, time_ns, data_root
         )
         header = BlockHeader(
             height=height,
             time_ns=time_ns,
             chain_id=self.chain_id,
             app_version=self.app.app_version,
-            data_hash=proposal.data_root,
+            data_hash=data_root,
             app_hash=app_hash,
-            square_size=proposal.square_size,
+            square_size=square_size,
         )
-        block = Block(header, proposal.block_txs, results)
+        block = Block(header, list(block_txs), results)
         self.blocks.append(block)
-        # retain the proposal's EDS + layout for proof queries (bounded)
-        self._eds_cache[height] = {
-            "eds": proposal.eds,
-            "dah": proposal.dah,
-            "square": proposal.square,
-            "wrappers": proposal.wrappers,
-        }
-        for h in [h for h in self._eds_cache if h <= height - self.eds_cache_blocks]:
-            del self._eds_cache[h]
+        # retain the proposal's EDS + layout for proof queries (bounded);
+        # non-proposers reconstruct on demand via _block_artifacts
+        if artifacts is not None:
+            self._eds_cache[height] = {
+                "eds": artifacts.eds,
+                "dah": artifacts.dah,
+                "square": artifacts.square,
+                "wrappers": artifacts.wrappers,
+            }
+            for h in [
+                h for h in self._eds_cache
+                if h <= height - self.eds_cache_blocks
+            ]:
+                del self._eds_cache[h]
         # index included txs + drop them from the mempool
-        for raw, res in zip(proposal.block_txs, results):
+        for raw, res in zip(block_txs, results):
             h = hashlib.sha256(raw).digest()
             self._tx_index[h] = {"code": res.code, "log": res.log, "height": height}
             self.mempool.remove(h)
@@ -268,6 +290,59 @@ class TestNode:
             self.snapshots.create(self.app)
             self.snapshots.prune(self.snapshot_keep_recent)
         return block
+
+    # ------------------------------------------------------------------
+    # consensus surface for an EXTERNAL coordinator (multi-process
+    # replication): a coordinator drives N such nodes over gRPC through
+    # prepare/process/commit, this node never self-produces
+    # ------------------------------------------------------------------
+
+    def cons_prepare(self) -> dict:
+        """Proposer half of a round: reap own mempool, PrepareProposal.
+        Returns native bytes; the gRPC handler does the wire encoding."""
+        with self._service_lock:
+            mem_txs = self.mempool.reap()
+            proposal = self.app.prepare_proposal([t.raw for t in mem_txs])
+            self._pending_proposal = proposal  # reuse EDS on self-commit
+            return {
+                "block_txs": list(proposal.block_txs),
+                "square_size": proposal.square_size,
+                "data_root": proposal.data_root,
+            }
+
+    def cons_process(
+        self, block_txs: List[bytes], square_size: int, data_root: bytes
+    ) -> Tuple[bool, str]:
+        """Validator half: vote on a foreign proposal."""
+        with self._service_lock:
+            return self.app.process_proposal(block_txs, square_size, data_root)
+
+    def cons_commit(
+        self,
+        block_txs: List[bytes],
+        height: int,
+        time_ns: int,
+        data_root: bytes,
+        square_size: int,
+    ) -> bytes:
+        """Finalize a quorum-committed block; returns the app hash."""
+        with self._service_lock:
+            if height != self.height + 1:
+                raise ValueError(
+                    f"commit height {height} != expected {self.height + 1}"
+                )
+            pending = getattr(self, "_pending_proposal", None)
+            artifacts = (
+                pending
+                if pending is not None and pending.data_root == data_root
+                else None
+            )
+            self._pending_proposal = None
+            block = self._apply_block(
+                height, time_ns, block_txs, data_root, square_size,
+                artifacts=artifacts,
+            )
+            return block.header.app_hash
 
     @classmethod
     def from_snapshot(

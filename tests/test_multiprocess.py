@@ -120,3 +120,91 @@ def test_follower_txsim_load(leader):
     assert out.returncode == 0, out.stdout + out.stderr
     rep = json.loads(out.stdout.strip().splitlines()[-1])
     assert rep["submitted"] == 4 and rep["failed"] == 0
+
+
+def test_three_process_validator_net(tmp_path_factory):
+    """Three validator PROCESSES + the coordinator CLI: replication with
+    nothing shared but a genesis file and gRPC addresses."""
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+
+    base = tmp_path_factory.mktemp("procnet")
+    val_keys = [PrivateKey.from_seed(b"procnet-val-%d" % i) for i in range(3)]
+    genesis = {
+        "chain_id": "procnet-3",
+        "genesis_time_ns": 1_700_000_000_000_000_000,
+        "accounts": [
+            {"address": k.public_key().address().hex(), "balance": 10**12}
+            for k in val_keys
+        ],
+        "validators": [
+            {
+                "address": k.public_key().address().hex(),
+                "self_delegation": 100_000_000,
+            }
+            for k in val_keys
+        ],
+    }
+    shared = base / "genesis.json"
+    shared.write_text(json.dumps(genesis))
+
+    nodes, addrs = [], []
+    try:
+        for i in range(3):
+            home = base / f"val{i}"
+            out = _cli(home, "init", "--chain-id", "procnet-3",
+                       "--genesis", str(shared), timeout=60)
+            assert out.returncode == 0, out.stderr
+            key_file = home / "config" / "priv_validator_key.json"
+            key_file.write_text(
+                json.dumps({"priv_key": f"{val_keys[i].d:064x}"})
+            )
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "celestia_tpu.cli",
+                    "--home", str(home), "start", "--validator",
+                    "--grpc-address", "127.0.0.1:0",
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                text=True,
+                cwd=REPO,
+                env=_CHILD_ENV,
+            )
+            line = proc.stdout.readline()
+            assert proc.poll() is None, f"validator {i} died at startup"
+            addrs.append(json.loads(line)["grpc"])
+            nodes.append(proc)
+
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "celestia_tpu.cli", "coordinator",
+                "--peers", ",".join(addrs), "--blocks", "4",
+                "--block-interval", "0.1",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=420,
+            cwd=REPO,
+            env=_CHILD_ENV,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
+        assert [b["height"] for b in lines] == [2, 3, 4, 5]
+        # every committed block reports one agreed app hash; proposers rotate
+        assert len({b["proposer"] for b in lines}) == 3
+        # all three validator processes report the same chain state
+        statuses = []
+        for addr in addrs:
+            out = _cli(base / "val0", "status", "--node", addr)
+            statuses.append(json.loads(out.stdout.strip().splitlines()[-1]))
+        assert {s["height"] for s in statuses} == {5}
+        assert len({s["app_hash"] for s in statuses}) == 1
+        assert len({s["data_root"] for s in statuses}) == 1
+    finally:
+        for proc in nodes:
+            proc.send_signal(signal.SIGINT)
+        for proc in nodes:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()

@@ -1,0 +1,206 @@
+"""Multi-process consensus coordinator: replication across node PROCESSES.
+
+The wire-level upgrade of node/network.py's in-process replication
+(ADR 005): N ``celestia-tpu start`` processes expose the consensus surface
+(ConsPrepare / ConsProcess / ConsCommit) over gRPC; this coordinator
+sequences the Tendermint-shaped round across them —
+
+  1. the height's proposer (round-robin, rotating on rejection) prepares a
+     proposal from ITS OWN mempool;
+  2. every other validator votes by re-validating on its own state;
+  3. on >= 2/3 of voting power accepting, every validator commits and the
+     returned app hashes MUST agree (``ConsensusFailure`` otherwise).
+
+Tx gossip is emulated by broadcasting client txs to every validator
+(gossip_tx).  The coordinator holds no state of its own beyond the block
+log — all chain state lives in the validator processes, which is what makes
+this a real replication test: the processes share nothing but their
+genesis file and these RPCs.
+
+Reference analogue: celestia-core's consensus driving N nodes over p2p
+(test/e2e/testnet.go:62-96 shape); SURVEY §2.3 state-machine replication.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from celestia_tpu.node.network import ConsensusFailure, RoundResult, Vote
+
+
+@dataclass
+class PeerValidator:
+    name: str
+    client: object  # RemoteNode (or any object with the cons_* surface)
+    power: int = 100
+    height: int = 0  # last height this peer committed (coordinator view)
+
+
+class ProcessCoordinator:
+    """Drives consensus rounds across remote validator processes."""
+
+    def __init__(self, peers: Sequence[PeerValidator], block_interval_ns: int = 10**9):
+        if not peers:
+            raise ValueError("need at least one validator peer")
+        self.peers = list(peers)
+        self.block_interval_ns = block_interval_ns
+        status = self.peers[0].client.status()
+        self.height = int(status["height"])
+        # block timestamps continue the CHAIN's clock, not the wall clock:
+        # a wall-clock jump would make time-based inflation mint the gap in
+        # one block and break parity with the other consensus drivers
+        self._now_ns = int(status.get("time_ns") or 0)
+        if self._now_ns == 0:
+            self._now_ns = int(status.get("genesis_time_ns") or _time.time_ns())
+        for peer in self.peers:
+            peer.height = int(peer.client.status()["height"])
+        self.rounds: List[RoundResult] = []
+        self.blocks: List[dict] = []
+
+    @property
+    def total_power(self) -> int:
+        return sum(p.power for p in self.peers)
+
+    def gossip_tx(self, raw: bytes):
+        """Broadcast a tx to every validator's mempool (gossip emulation).
+        Returns the FIRST non-zero result if any validator rejects."""
+        first_bad = None
+        for peer in self.peers:
+            res = peer.client.broadcast_tx(raw)
+            if res.code != 0 and first_bad is None:
+                first_bad = res
+        return first_bad
+
+    def produce_block(self, max_rounds: Optional[int] = None):
+        height = self.height + 1
+        if max_rounds is None:
+            max_rounds = len(self.peers)
+        last = None
+        for round_ in range(max_rounds):
+            last = self._run_round(height, round_)
+            if last.committed:
+                return last
+        raise RuntimeError(
+            f"no block committed at height {height} after {max_rounds} rounds: "
+            f"{[(v.validator, v.accept, v.reason) for v in last.votes]}"
+        )
+
+    def catch_up(self, peer: PeerValidator) -> bool:
+        """Replay blocks a peer missed through its consensus surface;
+        True if the peer reaches the coordinator's height."""
+        for blk in self.blocks:
+            if blk["height"] <= peer.height:
+                continue
+            try:
+                app_hash = peer.client.cons_commit(
+                    blk["block_txs"], blk["height"], blk["time_ns"],
+                    blk["data_root"], blk["square_size"],
+                )
+            except Exception:
+                return False
+            if app_hash != blk["app_hash"]:
+                raise ConsensusFailure(
+                    f"{peer.name} diverged during catch-up at height "
+                    f"{blk['height']}"
+                )
+            peer.height = blk["height"]
+        return peer.height == self.height
+
+    def _run_round(self, height: int, round_: int) -> RoundResult:
+        proposer = self.peers[(height + round_) % len(self.peers)]
+        self._now_ns += self.block_interval_ns
+        # stale peers (missed commits) must not vote on state they don't
+        # have: try to catch them up first; still-stale peers sit out
+        current = []
+        for peer in self.peers:
+            if peer.height == self.height or self.catch_up(peer):
+                current.append(peer)
+        if proposer not in current:
+            result = RoundResult(
+                height, proposer.name, False,
+                [Vote(proposer.name, False, "proposer is stale/unreachable")],
+            )
+            self.rounds.append(result)
+            return result
+        try:
+            proposal = proposer.client.cons_prepare()
+        except Exception as e:  # crashed proposer forfeits its round
+            result = RoundResult(
+                height, proposer.name, False,
+                [Vote(proposer.name, False, f"proposer crashed: {e}")],
+            )
+            self.rounds.append(result)
+            return result
+        votes: List[Vote] = []
+        accept_power = 0
+        for peer in self.peers:
+            if peer not in current:
+                votes.append(Vote(peer.name, False, "stale: sitting out"))
+                continue
+            if peer is proposer:
+                ok, reason = True, "proposer"
+            else:
+                try:
+                    ok, reason = peer.client.cons_process(
+                        proposal["block_txs"],
+                        proposal["square_size"],
+                        proposal["data_root"],
+                    )
+                except Exception as e:  # unreachable validator = NO vote
+                    ok, reason = False, f"vote failed: {e}"
+            votes.append(Vote(peer.name, ok, reason))
+            if ok:
+                accept_power += peer.power
+        committed = accept_power * 3 >= self.total_power * 2
+        result = RoundResult(height, proposer.name, committed, votes)
+        if committed:
+            app_hashes = {}
+            missed = []
+            for peer in self.peers:
+                if peer not in current:
+                    missed.append(peer.name)
+                    continue
+                try:
+                    app_hashes[peer.name] = peer.client.cons_commit(
+                        proposal["block_txs"], height, self._now_ns,
+                        proposal["data_root"], proposal["square_size"],
+                    )
+                    peer.height = height
+                except Exception:
+                    # an unreachable validator misses the commit and must
+                    # catch up next round — the quorum's block stands
+                    missed.append(peer.name)
+            if not app_hashes:
+                raise ConsensusFailure(
+                    f"no validator could commit height {height}"
+                )
+            if len(set(app_hashes.values())) != 1:
+                raise ConsensusFailure(
+                    f"app hash divergence at height {height}: "
+                    f"{{ {', '.join(f'{n}: {h.hex()[:12]}' for n, h in app_hashes.items())} }}"
+                )
+            self.height = height
+            self.blocks.append(
+                {
+                    "height": height,
+                    "time_ns": self._now_ns,
+                    "block_txs": proposal["block_txs"],
+                    "square_size": proposal["square_size"],
+                    "data_root": proposal["data_root"],
+                    "app_hash": next(iter(app_hashes.values())),
+                    "proposer": proposer.name,
+                    "n_txs": len(proposal["block_txs"]),
+                    "missed": missed,
+                }
+            )
+        self.rounds.append(result)
+        return result
+
+    def produce_blocks(self, n: int) -> List[dict]:
+        out = []
+        for _ in range(n):
+            self.produce_block()
+            out.append(self.blocks[-1])
+        return out

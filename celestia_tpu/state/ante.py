@@ -35,6 +35,7 @@ from celestia_tpu.utils.secp256k1 import PublicKey
 TX_SIZE_COST_PER_BYTE = 10
 MAX_MEMO_CHARACTERS = 256
 MAX_TX_GAS = 50_000_000
+SIG_VERIFY_COST_SECP256K1 = 1000  # per signature (SDK default)
 
 
 class AnteError(ValueError):
@@ -185,6 +186,15 @@ def verify_signature(ctx: AnteContext) -> None:
         raise AnteError(
             f"account sequence mismatch, expected {acc.sequence}, got {tx.sequence}: "
             f"incorrect account sequence"
+        )
+    if tx.is_multisig():
+        # charge sig-verify gas PER member signature before doing the EC
+        # work (SDK SigVerificationDecorator parity) — without this a
+        # 255-entry multisig gets hundreds of verifications for free, a
+        # CheckTx/FilterTxs CPU DoS vector
+        n_entries = max(1, len(tx.signature) // 65)
+        ctx.gas_meter.consume(
+            n_entries * SIG_VERIFY_COST_SECP256K1, "multisig verify"
         )
     sig_ok = ctx.sig_ok
     if sig_ok is None:

@@ -276,17 +276,25 @@ class App:
                 decoded.append((raw, tx, raw_inner, None))
             except (AnteError, ValueError) as e:
                 decoded.append((raw, None, None, e))
-        live = [d for d in decoded if d[1] is not None]
+        # single-key txs batch-verify natively; multisig txs fall back to
+        # inline verification inside the ante chain (sig_ok=None)
+        live = [d for d in decoded if d[1] is not None and not d[1].is_multisig()]
         sig_results = verify_batch(
             [tx.sign_bytes(self.chain_id) for _, tx, _, _ in live],
             [tx.signature for _, tx, _, _ in live],
             [tx.pubkey for _, tx, _, _ in live],
         )
         ok_iter = iter(sig_results)
-        return [
-            (raw, tx, raw_inner, next(ok_iter) if tx is not None else False, err)
-            for raw, tx, raw_inner, err in decoded
-        ]
+        out = []
+        for raw, tx, raw_inner, err in decoded:
+            if tx is None:
+                sig_ok = False
+            elif tx.is_multisig():
+                sig_ok = None
+            else:
+                sig_ok = next(ok_iter)
+            out.append((raw, tx, raw_inner, sig_ok, err))
+        return out
 
     def _filter_txs(self, txs: List[bytes]) -> List[bytes]:
         """FilterTxs parity (validate_txs.go:29-97): run the ante chain over

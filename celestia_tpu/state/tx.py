@@ -21,7 +21,12 @@ from typing import List, Optional, Tuple, Union
 
 from celestia_tpu.da.namespace import Namespace
 from celestia_tpu.da.shares import _read_varint, _varint
-from celestia_tpu.utils.secp256k1 import PrivateKey, PublicKey
+from celestia_tpu.utils.secp256k1 import (
+    MULTISIG_PREFIX,
+    MultisigPubKey,
+    PrivateKey,
+    PublicKey,
+)
 
 ADDRESS_SIZE = 20
 
@@ -406,14 +411,26 @@ class Tx:
             self.account_number, self.memo, sig, self.timeout_height,
         )
 
+    def is_multisig(self) -> bool:
+        return bool(self.pubkey) and self.pubkey[0] == MULTISIG_PREFIX
+
     def verify_signature(self, chain_id: str) -> bool:
+        msg = self.sign_bytes(chain_id)
+        if self.is_multisig():
+            try:
+                mk = MultisigPubKey.unmarshal(self.pubkey)
+            except ValueError:
+                return False
+            return mk.verify(msg, self.signature)
         try:
             pk = PublicKey.from_compressed(self.pubkey)
         except ValueError:
             return False
-        return pk.verify(self.sign_bytes(chain_id), self.signature)
+        return pk.verify(msg, self.signature)
 
     def signer_address(self) -> bytes:
+        if self.is_multisig():
+            return MultisigPubKey.unmarshal(self.pubkey).address()
         return PublicKey.from_compressed(self.pubkey).address()
 
     def marshal(self) -> bytes:

@@ -149,6 +149,92 @@ class PublicKey:
         return hashlib.sha256(self.compressed()).digest()[:20]
 
 
+MULTISIG_PREFIX = 0xF0
+
+
+@dataclass(frozen=True)
+class MultisigPubKey:
+    """k-of-n threshold key over compressed secp256k1 keys.
+
+    Parity role: the SDK's LegacyAminoPubKey multisig accepted by the
+    reference's ante chain (SURVEY §2.1 ante 'multisig pubkeys').  Wire
+    form: 0xF0 | threshold | n | 33-byte keys...; the signature blob is a
+    concatenation of (key index byte | 64-byte r||s) entries.
+    """
+
+    threshold: int
+    keys: Tuple[bytes, ...]  # compressed pubkeys, order-significant
+
+    def __post_init__(self):
+        if not 1 <= self.threshold <= len(self.keys):
+            raise ValueError(
+                f"threshold {self.threshold} out of range for "
+                f"{len(self.keys)} keys"
+            )
+        if len(self.keys) > 255:
+            raise ValueError("at most 255 keys in a multisig")
+        for k in self.keys:
+            if len(k) != 33 or k[0] not in (2, 3):
+                raise ValueError("multisig member must be a compressed pubkey")
+
+    def marshal(self) -> bytes:
+        out = bytearray([MULTISIG_PREFIX, self.threshold, len(self.keys)])
+        for k in self.keys:
+            out += k
+        return bytes(out)
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "MultisigPubKey":
+        if len(raw) < 3 or raw[0] != MULTISIG_PREFIX:
+            raise ValueError("not a multisig pubkey")
+        threshold, n = raw[1], raw[2]
+        if len(raw) != 3 + 33 * n:
+            raise ValueError("truncated multisig pubkey")
+        keys = tuple(raw[3 + 33 * i : 3 + 33 * (i + 1)] for i in range(n))
+        return cls(threshold, keys)
+
+    def address(self) -> bytes:
+        return hashlib.sha256(self.marshal()).digest()[:20]
+
+    def verify(self, msg: bytes, sig_blob: bytes) -> bool:
+        """Canonical threshold verification: >= threshold entries, EVERY
+        entry must be a valid signature by a distinct member, and entries
+        must appear in strictly increasing index order.  Tolerating any
+        invalid or reordered entry would make the signature blob — and
+        therefore the tx hash — third-party malleable (the SDK's
+        LegacyAminoPubKey verification rejects such blobs the same way)."""
+        entry = 1 + 64
+        if not sig_blob or len(sig_blob) % entry:
+            return False
+        n_entries = len(sig_blob) // entry
+        if not self.threshold <= n_entries <= len(self.keys):
+            return False
+        last_idx = -1
+        for off in range(0, len(sig_blob), entry):
+            idx = sig_blob[off]
+            if idx >= len(self.keys) or idx <= last_idx:
+                return False  # unknown signer or non-canonical order
+            last_idx = idx
+            sig = sig_blob[off + 1 : off + entry]
+            try:
+                pk = PublicKey.from_compressed(self.keys[idx])
+            except ValueError:
+                return False
+            if not pk.verify(msg, sig):
+                return False  # any bad entry invalidates the whole blob
+        return True
+
+
+def combine_multisig_signatures(entries) -> bytes:
+    """[(key_index, 64-byte sig), ...] -> the tx signature blob."""
+    out = bytearray()
+    for idx, sig in sorted(entries):
+        if len(sig) != 64:
+            raise ValueError("each partial signature must be 64 bytes")
+        out += bytes([idx]) + sig
+    return bytes(out)
+
+
 def _verify_scalars(msg: bytes, sig: bytes):
     """Shared ECDSA pre-checks + scalar math; (r, u1, u2) or None.
 

@@ -1,0 +1,188 @@
+"""k-of-n multisig accounts through the full tx path.
+
+SURVEY §2.1 ante chain item 'multisig pubkeys' (the reference accepts SDK
+LegacyAminoPubKey multisigs; specs/src/specs/multisig.md).  A 2-of-3
+multisig account funds itself, collects partial signatures offline, and
+spends through the normal CheckTx -> block path.
+"""
+
+import pytest
+
+from celestia_tpu.client.signer import Signer
+from celestia_tpu.node.testnode import TestNode
+from celestia_tpu.state.tx import Fee, MsgSend, Tx
+from celestia_tpu.utils.secp256k1 import (
+    MultisigPubKey,
+    PrivateKey,
+    combine_multisig_signatures,
+)
+
+MEMBERS = [PrivateKey.from_seed(b"msig-%d" % i) for i in range(3)]
+MSIG = MultisigPubKey(2, tuple(k.public_key().compressed() for k in MEMBERS))
+
+
+def _multisig_tx(node, msgs, signer_indices, sequence=0, account_number=0):
+    tx = Tx(
+        tuple(msgs),
+        Fee(2000, 200_000),
+        MSIG.marshal(),
+        sequence,
+        account_number,
+    )
+    msg_bytes = tx.sign_bytes(node.chain_id)
+    entries = [
+        (i, MEMBERS[i].sign(msg_bytes)) for i in signer_indices
+    ]
+    return Tx(
+        tx.msgs, tx.fee, tx.pubkey, tx.sequence, tx.account_number,
+        tx.memo, combine_multisig_signatures(entries), tx.timeout_height,
+    )
+
+
+@pytest.fixture()
+def funded_node():
+    alice = PrivateKey.from_seed(b"msig-funder")
+    node = TestNode(funded_accounts=[(alice, 10**12)])
+    funder = Signer(node, alice)
+    res = funder.submit_tx([MsgSend(funder.address, MSIG.address(), 10**9)])
+    assert res.code == 0, res.log
+    return node
+
+
+def test_wire_roundtrip_and_address():
+    raw = MSIG.marshal()
+    back = MultisigPubKey.unmarshal(raw)
+    assert back == MSIG
+    assert len(MSIG.address()) == 20
+    with pytest.raises(ValueError):
+        MultisigPubKey(4, MSIG.keys)  # threshold > n
+    with pytest.raises(ValueError):
+        MultisigPubKey.unmarshal(raw[:-1])
+
+
+def test_two_of_three_spends(funded_node):
+    node = funded_node
+    sink = b"\x77" * 20
+    num, seq = node.account_info(MSIG.address())
+    tx = _multisig_tx(
+        node, [MsgSend(MSIG.address(), sink, 12345)], [0, 2],
+        sequence=seq, account_number=num,
+    )
+    res = node.broadcast_tx(tx.marshal())
+    assert res.code == 0, res.log
+    node.produce_block()
+    assert node.app.bank.balance(sink) == 12345
+    acc = node.app.accounts.get_or_create(MSIG.address())
+    assert acc.sequence == seq + 1
+
+
+def test_single_signature_insufficient(funded_node):
+    node = funded_node
+    num, seq = node.account_info(MSIG.address())
+    tx = _multisig_tx(
+        node, [MsgSend(MSIG.address(), b"\x78" * 20, 5)], [1],
+        sequence=seq, account_number=num,
+    )
+    res = node.broadcast_tx(tx.marshal())
+    assert res.code != 0
+    assert "signature verification failed" in res.log
+
+
+def test_duplicate_signer_rejected(funded_node):
+    node = funded_node
+    num, seq = node.account_info(MSIG.address())
+    tx = Tx(
+        (MsgSend(MSIG.address(), b"\x79" * 20, 5),),
+        Fee(2000, 200_000), MSIG.marshal(), seq, num,
+    )
+    msg_bytes = tx.sign_bytes(node.chain_id)
+    sig = MEMBERS[0].sign(msg_bytes)
+    blob = bytes([0]) + sig + bytes([0]) + sig  # same member twice
+    signed = Tx(tx.msgs, tx.fee, tx.pubkey, tx.sequence, tx.account_number,
+                tx.memo, blob, tx.timeout_height)
+    res = node.broadcast_tx(signed.marshal())
+    assert res.code != 0
+
+
+def test_non_member_signature_rejected(funded_node):
+    node = funded_node
+    outsider = PrivateKey.from_seed(b"msig-outsider")
+    num, seq = node.account_info(MSIG.address())
+    tx = Tx(
+        (MsgSend(MSIG.address(), b"\x7a" * 20, 5),),
+        Fee(2000, 200_000), MSIG.marshal(), seq, num,
+    )
+    msg_bytes = tx.sign_bytes(node.chain_id)
+    blob = combine_multisig_signatures(
+        [(0, MEMBERS[0].sign(msg_bytes)), (1, outsider.sign(msg_bytes))]
+    )
+    signed = Tx(tx.msgs, tx.fee, tx.pubkey, tx.sequence, tx.account_number,
+                tx.memo, blob, tx.timeout_height)
+    res = node.broadcast_tx(signed.marshal())
+    assert res.code != 0, "an outsider signature must not count"
+
+
+def test_multisig_in_full_proposal_path(funded_node):
+    """Multisig txs flow through FilterTxs' batch path (inline fallback)."""
+    node = funded_node
+    sink = b"\x7b" * 20
+    num, seq = node.account_info(MSIG.address())
+    tx = _multisig_tx(
+        node, [MsgSend(MSIG.address(), sink, 999)], [0, 1],
+        sequence=seq, account_number=num,
+    )
+    proposal = node.app.prepare_proposal([tx.marshal()])
+    assert tx.marshal() in proposal.block_txs
+    ok, reason = node.app.process_proposal(
+        proposal.block_txs, proposal.square_size, proposal.data_root
+    )
+    assert ok, reason
+
+
+def test_invalid_entry_invalidates_blob(funded_node):
+    """A blob containing ANY bad signature must be rejected even when
+    enough valid ones are present (third-party malleability)."""
+    node = funded_node
+    num, seq = node.account_info(MSIG.address())
+    tx = Tx(
+        (MsgSend(MSIG.address(), b"\x7c" * 20, 5),),
+        Fee(2000, 200_000), MSIG.marshal(), seq, num,
+    )
+    msg_bytes = tx.sign_bytes(node.chain_id)
+    good = combine_multisig_signatures(
+        [(0, MEMBERS[0].sign(msg_bytes)), (1, MEMBERS[1].sign(msg_bytes))]
+    )
+    # append a garbage entry for the unused member: verification must fail
+    padded = good + bytes([2]) + b"\x00" * 64
+    signed = Tx(tx.msgs, tx.fee, tx.pubkey, tx.sequence, tx.account_number,
+                tx.memo, padded, tx.timeout_height)
+    res = node.broadcast_tx(signed.marshal())
+    assert res.code != 0
+    # out-of-order entries are equally non-canonical
+    e0 = (0, MEMBERS[0].sign(msg_bytes))
+    e1 = (1, MEMBERS[1].sign(msg_bytes))
+    reordered = bytes([e1[0]]) + e1[1] + bytes([e0[0]]) + e0[1]
+    signed = Tx(tx.msgs, tx.fee, tx.pubkey, tx.sequence, tx.account_number,
+                tx.memo, reordered, tx.timeout_height)
+    res = node.broadcast_tx(signed.marshal())
+    assert res.code != 0
+
+
+def test_multisig_gas_charged_per_signature(funded_node):
+    """Gas must cover per-signature verification cost up front."""
+    node = funded_node
+    num, seq = node.account_info(MSIG.address())
+    tx = Tx(
+        (MsgSend(MSIG.address(), b"\x7d" * 20, 5),),
+        Fee(2000, 2500),  # below tx-size gas + 2x sig-verify cost
+        MSIG.marshal(), seq, num,
+    )
+    msg_bytes = tx.sign_bytes(node.chain_id)
+    blob = combine_multisig_signatures(
+        [(0, MEMBERS[0].sign(msg_bytes)), (1, MEMBERS[1].sign(msg_bytes))]
+    )
+    signed = Tx(tx.msgs, tx.fee, tx.pubkey, tx.sequence, tx.account_number,
+                tx.memo, blob, tx.timeout_height)
+    res = node.broadcast_tx(signed.marshal())
+    assert res.code != 0
+    assert "out of gas" in res.log

@@ -215,11 +215,12 @@ def extend_and_header(
     return eds, dah
 
 
+_eds_nmt_roots_jit = jax.jit(nmt_ops.eds_nmt_roots)  # one cache for all calls
+
+
 def new_data_availability_header(eds: ExtendedDataSquare) -> DataAvailabilityHeader:
     """da.NewDataAvailabilityHeader parity: roots + hash from an existing EDS."""
-    roots = np.asarray(
-        jax.jit(nmt_ops.eds_nmt_roots)(jnp.asarray(eds.shares))
-    )
+    roots = np.asarray(_eds_nmt_roots_jit(jnp.asarray(eds.shares)))
     rows = tuple(roots[0, i].tobytes() for i in range(roots.shape[1]))
     cols = tuple(roots[1, i].tobytes() for i in range(roots.shape[1]))
     return DataAvailabilityHeader(

@@ -257,6 +257,74 @@ def cmd_tx(args) -> int:
             data = bytes.fromhex(args.data)
         ns = Namespace.v0(bytes.fromhex(args.namespace))
         res = signer.submit_pay_for_blob([Blob(ns, data)])
+    elif args.tx_cmd == "delegate":
+        from celestia_tpu.state.tx import MsgDelegate
+
+        res = signer.submit_tx([
+            MsgDelegate(
+                signer.address, bytes.fromhex(args.validator),
+                int(args.amount),
+            )
+        ])
+    elif args.tx_cmd == "undelegate":
+        from celestia_tpu.state.tx import MsgUndelegate
+
+        res = signer.submit_tx([
+            MsgUndelegate(
+                signer.address, bytes.fromhex(args.validator),
+                int(args.amount),
+            )
+        ])
+    elif args.tx_cmd == "withdraw-rewards":
+        from celestia_tpu.state.tx import MsgWithdrawDelegatorReward
+
+        res = signer.submit_tx([
+            MsgWithdrawDelegatorReward(
+                signer.address, bytes.fromhex(args.validator)
+            )
+        ])
+    elif args.tx_cmd == "withdraw-commission":
+        from celestia_tpu.state.tx import MsgWithdrawValidatorCommission
+
+        res = signer.submit_tx([MsgWithdrawValidatorCommission(signer.address)])
+    elif args.tx_cmd == "fund-community-pool":
+        from celestia_tpu.state.tx import MsgFundCommunityPool
+
+        res = signer.submit_tx([
+            MsgFundCommunityPool(signer.address, int(args.amount))
+        ])
+    elif args.tx_cmd == "grant-allowance":
+        from celestia_tpu.state.modules.feegrant import KIND_BASIC, KIND_PERIODIC
+        from celestia_tpu.state.tx import MsgGrantAllowance
+
+        res = signer.submit_tx([
+            MsgGrantAllowance(
+                signer.address, bytes.fromhex(args.grantee),
+                KIND_PERIODIC if args.period_ns else KIND_BASIC,
+                int(args.spend_limit), int(args.expiration_ns),
+                int(args.period_ns), int(args.period_spend_limit),
+            )
+        ])
+    elif args.tx_cmd == "revoke-allowance":
+        from celestia_tpu.state.tx import MsgRevokeAllowance
+
+        res = signer.submit_tx([
+            MsgRevokeAllowance(signer.address, bytes.fromhex(args.grantee))
+        ])
+    elif args.tx_cmd == "authz-grant":
+        from celestia_tpu.state.tx import MsgAuthzGrant
+
+        res = signer.submit_tx([
+            MsgAuthzGrant(
+                signer.address, bytes.fromhex(args.grantee),
+                int(args.msg_type), int(args.spend_limit),
+                int(args.expiration_ns),
+            )
+        ])
+    elif args.tx_cmd == "unjail":
+        from celestia_tpu.state.tx import MsgUnjail
+
+        res = signer.submit_tx([MsgUnjail(signer.address)])
     else:  # pragma: no cover
         raise SystemExit(f"unknown tx command {args.tx_cmd}")
     # submit_tx / submit_pay_for_blob broadcast AND poll-confirm; the
@@ -300,6 +368,22 @@ def cmd_query(args) -> int:
             "custom/proof/tx", {"height": args.height, "tx_index": args.index}
         )
         print(json.dumps(value))
+    elif args.query_cmd == "rewards":
+        value = node.abci_query(
+            "custom/distribution/rewards",
+            {"delegator": args.delegator, "validator": args.validator},
+        )
+        print(json.dumps(value))
+    elif args.query_cmd == "community-pool":
+        print(json.dumps(node.abci_query(
+            "custom/distribution/community-pool", {}
+        )))
+    elif args.query_cmd == "signing-info":
+        print(json.dumps(node.abci_query(
+            "custom/slashing/signing-info", {"validator": args.validator}
+        )))
+    elif args.query_cmd == "invariants":
+        print(json.dumps(node.abci_query("custom/crisis/invariants", {})))
     return 0
 
 
@@ -499,6 +583,31 @@ def build_parser() -> argparse.ArgumentParser:
     t2 = ts.add_parser("pay-for-blob")
     t2.add_argument("namespace", help="hex user namespace (<=10 bytes)")
     t2.add_argument("data", help="hex blob data, or @file")
+    t3 = ts.add_parser("delegate")
+    t3.add_argument("validator")
+    t3.add_argument("amount")
+    t3 = ts.add_parser("undelegate")
+    t3.add_argument("validator")
+    t3.add_argument("amount")
+    t3 = ts.add_parser("withdraw-rewards")
+    t3.add_argument("validator")
+    ts.add_parser("withdraw-commission")
+    t3 = ts.add_parser("fund-community-pool")
+    t3.add_argument("amount")
+    t3 = ts.add_parser("grant-allowance")
+    t3.add_argument("grantee")
+    t3.add_argument("--spend-limit", default=0)
+    t3.add_argument("--expiration-ns", default=0)
+    t3.add_argument("--period-ns", default=0)
+    t3.add_argument("--period-spend-limit", default=0)
+    t3 = ts.add_parser("revoke-allowance")
+    t3.add_argument("grantee")
+    t3 = ts.add_parser("authz-grant")
+    t3.add_argument("grantee")
+    t3.add_argument("msg_type", help="numeric Msg TYPE id to authorize")
+    t3.add_argument("--spend-limit", default=0)
+    t3.add_argument("--expiration-ns", default=0)
+    ts.add_parser("unjail")
     sp.set_defaults(fn=cmd_tx)
 
     sp = sub.add_parser("query", help="query node state")
@@ -524,6 +633,13 @@ def build_parser() -> argparse.ArgumentParser:
     q = qs.add_parser("tx-proof")
     q.add_argument("height", type=int)
     q.add_argument("index", type=int)
+    q = qs.add_parser("rewards")
+    q.add_argument("delegator")
+    q.add_argument("validator")
+    qs.add_parser("community-pool")
+    q = qs.add_parser("signing-info")
+    q.add_argument("validator")
+    qs.add_parser("invariants")
     sp.set_defaults(fn=cmd_query)
 
     sp = sub.add_parser("status", help="node status")

@@ -132,7 +132,7 @@ class RemoteNode:
 
     def cons_commit(
         self, block_txs, height: int, time_ns: int, data_root: bytes,
-        square_size: int,
+        square_size: int, proposer: bytes = b"", votes=None,
     ) -> bytes:
         out = self._call_json(
             "ConsCommit",
@@ -142,6 +142,12 @@ class RemoteNode:
                 "time_ns": time_ns,
                 "data_root": data_root.hex(),
                 "square_size": square_size,
+                "proposer": proposer.hex(),
+                "votes": (
+                    [[a.hex(), bool(ok)] for a, ok in votes]
+                    if votes is not None
+                    else None
+                ),
             },
         )
         return bytes.fromhex(out["app_hash"])

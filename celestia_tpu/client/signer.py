@@ -88,13 +88,14 @@ class Signer:
         memo: str = "",
         sequence: Optional[int] = None,
         timeout_height: int = 0,
+        fee_granter: bytes = b"",
     ) -> Tx:
         with self._lock:
             seq = self._sequence if sequence is None else sequence
             tx = Tx(
                 tuple(msgs), self._fee(gas_limit, gas_price),
                 self.pubkey.compressed(), seq, self.account_number, memo,
-                timeout_height=timeout_height,
+                timeout_height=timeout_height, fee_granter=fee_granter,
             )
             return tx.signed(self.key, self.chain_id)
 

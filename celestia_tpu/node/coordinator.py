@@ -36,6 +36,7 @@ class PeerValidator:
     client: object  # RemoteNode (or any object with the cons_* surface)
     power: int = 100
     height: int = 0  # last height this peer committed (coordinator view)
+    address: bytes = b""  # staking operator address (from node status)
 
 
 class ProcessCoordinator:
@@ -55,7 +56,12 @@ class ProcessCoordinator:
         if self._now_ns == 0:
             self._now_ns = int(status.get("genesis_time_ns") or _time.time_ns())
         for peer in self.peers:
-            peer.height = int(peer.client.status()["height"])
+            peer_status = peer.client.status()
+            peer.height = int(peer_status["height"])
+            if not peer.address:
+                peer.address = bytes.fromhex(
+                    peer_status.get("validator_address", "") or ""
+                )
         self.rounds: List[RoundResult] = []
         self.blocks: List[dict] = []
 
@@ -97,6 +103,8 @@ class ProcessCoordinator:
                 app_hash = peer.client.cons_commit(
                     blk["block_txs"], blk["height"], blk["time_ns"],
                     blk["data_root"], blk["square_size"],
+                    proposer=blk.get("proposer_address", b""),
+                    votes=blk.get("votes"),
                 )
             except Exception:
                 return False
@@ -156,6 +164,13 @@ class ProcessCoordinator:
         committed = accept_power * 3 >= self.total_power * 2
         result = RoundResult(height, proposer.name, committed, votes)
         if committed:
+            # the commit info every replica must apply identically (ABCI
+            # LastCommitInfo role: distribution + slashing inputs)
+            vote_pairs = [
+                (peer.address, vote.accept)
+                for peer, vote in zip(self.peers, votes)
+                if peer.address
+            ]
             app_hashes = {}
             missed = []
             for peer in self.peers:
@@ -166,6 +181,7 @@ class ProcessCoordinator:
                     app_hashes[peer.name] = peer.client.cons_commit(
                         proposal["block_txs"], height, self._now_ns,
                         proposal["data_root"], proposal["square_size"],
+                        proposer=proposer.address, votes=vote_pairs,
                     )
                     peer.height = height
                 except Exception:
@@ -191,6 +207,8 @@ class ProcessCoordinator:
                     "data_root": proposal["data_root"],
                     "app_hash": next(iter(app_hashes.values())),
                     "proposer": proposer.name,
+                    "proposer_address": proposer.address,
+                    "votes": vote_pairs,
                     "n_txs": len(proposal["block_txs"]),
                     "missed": missed,
                 }

@@ -226,16 +226,31 @@ class ValidatorNetwork:
         committed = accept_power * 3 >= self.total_power * 2
         result = RoundResult(height, proposer.name, committed, votes)
         if committed:
-            result.block = self._commit(height, proposal)
+            result.block = self._commit(height, proposal, proposer, votes)
         self.rounds.append(result)
         return result
 
-    def _commit(self, height: int, proposal: PreparedProposal) -> Block:
+    def _commit(
+        self,
+        height: int,
+        proposal: PreparedProposal,
+        proposer: Validator,
+        votes: List[Vote],
+    ) -> Block:
+        # the commit's proposer + votes feed x/distribution (proposer
+        # reward, power-weighted allocation) and x/slashing (liveness
+        # window) in every validator's BeginBlocker — identical inputs are
+        # a consensus requirement, like the block txs themselves
+        vote_pairs = [
+            (val.address, vote.accept)
+            for val, vote in zip(self.validators, votes)
+        ]
         app_hashes = []
         results_per_val = []
         for val in self.validators:
             results, _end, app_hash = val.app.finalize_block(
-                proposal.block_txs, height, self._now_ns, proposal.data_root
+                proposal.block_txs, height, self._now_ns, proposal.data_root,
+                proposer=proposer.address, votes=vote_pairs,
             )
             app_hashes.append(app_hash)
             results_per_val.append(results)
@@ -253,7 +268,10 @@ class ValidatorNetwork:
             app_hash=app_hashes[0],
             square_size=proposal.square_size,
         )
-        block = Block(header, proposal.block_txs, results_per_val[0])
+        block = Block(
+            header, proposal.block_txs, results_per_val[0],
+            proposer.address, vote_pairs,
+        )
         self.blocks.append(block)
         for raw, res in zip(proposal.block_txs, results_per_val[0]):
             h = hashlib.sha256(raw).digest()
@@ -349,11 +367,13 @@ class ValidatorNetwork:
                 raise ConsensusFailure(
                     f"catch-up: data root mismatch at height {blk.header.height}"
                 )
-        # phase 2: execute blocks to rebuild state
+        # phase 2: execute blocks to rebuild state (replaying each block's
+        # recorded commit info so distribution/slashing writes reproduce)
         for blk in self.blocks:
             _res, _end, app_hash = app.finalize_block(
                 blk.txs, blk.header.height, blk.header.time_ns,
                 blk.header.data_hash,
+                proposer=blk.proposer or None, votes=blk.votes,
             )
             if app_hash != blk.header.app_hash:
                 raise ConsensusFailure(

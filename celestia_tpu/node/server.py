@@ -85,6 +85,11 @@ class NodeService:
                 "data_root": latest.data_hash.hex() if latest else "",
                 "time_ns": latest.time_ns if latest else 0,
                 "genesis_time_ns": getattr(node.app, "genesis_time_ns", 0),
+                "validator_address": (
+                    node._validator_key.public_key().address().hex()
+                    if getattr(node, "_validator_key", None)
+                    else ""
+                ),
             }
         ).encode()
 
@@ -138,12 +143,19 @@ class NodeService:
 
     def cons_commit(self, req: bytes, ctx) -> bytes:
         q = json.loads(req)
+        votes = q.get("votes")
         app_hash = self.node.cons_commit(
             [bytes.fromhex(t) for t in q["block_txs"]],
             int(q["height"]),
             int(q["time_ns"]),
             bytes.fromhex(q["data_root"]),
             int(q["square_size"]),
+            proposer=bytes.fromhex(q.get("proposer", "") or ""),
+            votes=(
+                [(bytes.fromhex(a), bool(ok)) for a, ok in votes]
+                if votes is not None
+                else None
+            ),
         )
         return json.dumps({"app_hash": app_hash.hex()}).encode()
 

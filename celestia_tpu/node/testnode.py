@@ -50,6 +50,10 @@ class Block:
     header: BlockHeader
     txs: List[bytes]
     tx_results: List[TxResult] = field(default_factory=list)
+    # the commit info applied with this block (ABCI LastCommitInfo role);
+    # replayed verbatim during catch-up so app hashes reproduce
+    proposer: bytes = b""
+    votes: Optional[List[Tuple[bytes, bool]]] = None
 
 
 class TestNode:
@@ -230,9 +234,11 @@ class TestNode:
             raise RuntimeError(
                 f"node's own proposal rejected at height {height}: {reason}"
             )
+        val_addr = self._validator_key.public_key().address()
         return self._apply_block(
             height, time_ns, proposal.block_txs, proposal.data_root,
             proposal.square_size, artifacts=proposal,
+            proposer=val_addr, votes=[(val_addr, True)],
         )
 
     def _apply_block(
@@ -243,12 +249,18 @@ class TestNode:
         data_root: bytes,
         square_size: int,
         artifacts: Optional[object] = None,
+        proposer: bytes = b"",
+        votes: Optional[List[Tuple[bytes, bool]]] = None,
     ) -> Block:
         """Shared commit tail: finalize + header/block append, EDS cache,
         tx index, mempool maintenance, snapshotting.  Used by both the
-        self-producing path and the coordinator's cons_commit."""
+        self-producing path and the coordinator's cons_commit.  proposer +
+        votes are the previous commit's info (ABCI LastCommitInfo role):
+        they feed x/distribution and x/slashing, so every replica of one
+        block must receive identical values."""
         results, _end, app_hash = self.app.finalize_block(
-            block_txs, height, time_ns, data_root
+            block_txs, height, time_ns, data_root,
+            proposer=proposer or None, votes=votes,
         )
         header = BlockHeader(
             height=height,
@@ -259,7 +271,7 @@ class TestNode:
             app_hash=app_hash,
             square_size=square_size,
         )
-        block = Block(header, list(block_txs), results)
+        block = Block(header, list(block_txs), results, proposer, votes)
         self.blocks.append(block)
         # retain the proposal's EDS + layout for proof queries (bounded);
         # non-proposers reconstruct on demand via _block_artifacts
@@ -324,6 +336,8 @@ class TestNode:
         time_ns: int,
         data_root: bytes,
         square_size: int,
+        proposer: bytes = b"",
+        votes: Optional[List[Tuple[bytes, bool]]] = None,
     ) -> bytes:
         """Finalize a quorum-committed block; returns the app hash."""
         with self._service_lock:
@@ -340,7 +354,7 @@ class TestNode:
             self._pending_proposal = None
             block = self._apply_block(
                 height, time_ns, block_txs, data_root, square_size,
-                artifacts=artifacts,
+                artifacts=artifacts, proposer=proposer, votes=votes,
             )
             return block.header.app_hash
 
@@ -450,6 +464,59 @@ class TestNode:
                 "next_version_power": tally[0],
                 "total_power": tally[1],
             }
+        if path == "custom/distribution/rewards":
+            delegator = bytes.fromhex(data["delegator"])
+            validator = bytes.fromhex(data["validator"])
+            return {
+                "pending": self.app.distribution.pending_rewards(
+                    delegator, validator
+                )
+            }
+        if path == "custom/distribution/commission":
+            return {
+                "commission": self.app.distribution.commission(
+                    bytes.fromhex(data["validator"])
+                )
+            }
+        if path == "custom/distribution/community-pool":
+            return {"pool": self.app.distribution.community_pool()}
+        if path == "custom/slashing/signing-info":
+            operator = bytes.fromhex(data["validator"])
+            info = self.app.slashing.signing_info(operator)
+            v = self.app.staking.validator(operator)
+            return {
+                "missed_blocks": info.missed_blocks if info else 0,
+                "index_offset": info.index_offset if info else 0,
+                "jailed": bool(v and v.jailed),
+                "tombstoned": bool(v and v.tombstoned),
+                "jailed_until_ns": v.jailed_until_ns if v else 0,
+            }
+        if path == "custom/feegrant/allowance":
+            a = self.app.feegrant.get(
+                bytes.fromhex(data["granter"]), bytes.fromhex(data["grantee"])
+            )
+            if a is None:
+                return {"found": False}
+            return {
+                "found": True, "kind": a.kind, "spend_limit": a.spend_limit,
+                "expiration_ns": a.expiration_ns,
+                "period_can_spend": a.period_can_spend,
+            }
+        if path == "custom/authz/grant":
+            g = self.app.authz.get(
+                bytes.fromhex(data["granter"]), bytes.fromhex(data["grantee"]),
+                int(data["msg_type"]),
+            )
+            if g is None:
+                return {"found": False}
+            return {
+                "found": True, "msg_type": g.msg_type,
+                "spend_limit": g.spend_limit, "expiration_ns": g.expiration_ns,
+            }
+        if path == "custom/crisis/invariants":
+            from celestia_tpu.state.invariants import assert_invariants
+
+            return assert_invariants(self.app)
         if path == "custom/proof/share":
             height = int(data["height"])
             art = self._block_artifacts(height)

@@ -36,6 +36,7 @@ TX_SIZE_COST_PER_BYTE = 10
 MAX_MEMO_CHARACTERS = 256
 MAX_TX_GAS = 50_000_000
 SIG_VERIFY_COST_SECP256K1 = 1000  # per signature (SDK default)
+TX_SIG_LIMIT = 7  # max signatures per tx (SDK auth param default)
 
 
 class AnteError(ValueError):
@@ -78,10 +79,26 @@ class AnteContext:
     sig_ok: Optional[bool] = None
     # height the tx would execute at (0 = unknown: timeout not evaluated)
     height: int = 0
+    # x/feegrant keeper (None = feegrant not wired; fee_granter txs reject)
+    feegrant: Optional[object] = None
+    # block time for allowance expiry checks (0 = unknown)
+    time_ns: int = 0
 
     def __post_init__(self):
         if self.gas_meter is None:
             self.gas_meter = GasMeter(self.tx.fee.gas_limit)
+
+
+def flat_msgs(tx: Tx):
+    """The tx's messages with authz MsgExec unwrapped one level (nested
+    exec is rejected at decode).  EVERY per-message ante rule must see
+    wrapped messages too, or MsgExec becomes a decorator bypass — the
+    reference's gatekeeper and blob decorators unwrap the same way."""
+    flat = []
+    for m in tx.msgs:
+        flat.append(m)
+        flat.extend(getattr(m, "inner", ()))
+    return flat
 
 
 # --- decorators -------------------------------------------------------------
@@ -93,7 +110,7 @@ def msg_gatekeeper(ctx: AnteContext) -> None:
     from celestia_tpu.state.app_versions import msgs_accepted_at
 
     accepted = msgs_accepted_at(ctx.app_version)
-    for m in ctx.tx.msgs:
+    for m in flat_msgs(ctx.tx):
         if type(m) not in accepted:
             raise AnteError(
                 f"message {type(m).__name__} not accepted at app version "
@@ -132,6 +149,24 @@ def consume_tx_size_gas(ctx: AnteContext) -> None:
     ctx.gas_meter.consume(len(ctx.raw_tx) * TX_SIZE_COST_PER_BYTE, "tx size")
 
 
+def validate_sig_count(ctx: AnteContext) -> None:
+    """ValidateSigCountDecorator: a multisig's member pubkeys count against
+    the tx signature limit (SDK TxSigLimit default 7)."""
+    if not ctx.tx.is_multisig():
+        return
+    from celestia_tpu.utils.secp256k1 import MultisigPubKey
+
+    try:
+        mk = MultisigPubKey.unmarshal(ctx.tx.pubkey)
+    except ValueError as e:
+        raise AnteError(f"malformed multisig pubkey: {e}") from e
+    if len(mk.keys) > TX_SIG_LIMIT:
+        raise AnteError(
+            f"multisig has {len(mk.keys)} pubkeys > tx signature limit "
+            f"{TX_SIG_LIMIT}"
+        )
+
+
 def check_and_deduct_fee(ctx: AnteContext) -> None:
     """ValidateTxFee + DeductFeeDecorator: enforce the network-wide min gas
     price (x/minfee) and the node-local one (CheckTx), then move the fee to
@@ -158,8 +193,20 @@ def check_and_deduct_fee(ctx: AnteContext) -> None:
     if ctx.simulate:
         return
     signer = tx.signer_address()
+    payer = signer
+    if tx.fee_granter:
+        # the granter's allowance pays (DeductFeeDecorator's feegrant leg)
+        if ctx.feegrant is None:
+            raise AnteError("fee granter set but feegrant is not available")
+        try:
+            ctx.feegrant.use_grant(
+                tx.fee_granter, signer, tx.fee.amount, ctx.time_ns
+            )
+        except ValueError as e:
+            raise AnteError(f"fee allowance rejected: {e}") from e
+        payer = tx.fee_granter
     try:
-        ctx.bank.send(signer, FEE_COLLECTOR, tx.fee.amount)
+        ctx.bank.send(payer, FEE_COLLECTOR, tx.fee.amount)
     except ValueError as e:
         raise AnteError(f"fee deduction failed: {e}") from e
 
@@ -196,6 +243,9 @@ def verify_signature(ctx: AnteContext) -> None:
         ctx.gas_meter.consume(
             n_entries * SIG_VERIFY_COST_SECP256K1, "multisig verify"
         )
+    else:
+        # SigGasConsumeDecorator: single-key verification costs gas too
+        ctx.gas_meter.consume(SIG_VERIFY_COST_SECP256K1, "sig verify")
     sig_ok = ctx.sig_ok
     if sig_ok is None:
         sig_ok = tx.verify_signature(ctx.chain_id)
@@ -218,7 +268,7 @@ def min_gas_pfb(ctx: AnteContext) -> None:
     from celestia_tpu.appconsts import DEFAULT_GAS_PER_BLOB_BYTE
 
     gas_per_byte = ctx.params.get("blob", "GasPerBlobByte", DEFAULT_GAS_PER_BLOB_BYTE)
-    for m in ctx.tx.msgs:
+    for m in flat_msgs(ctx.tx):
         if isinstance(m, MsgPayForBlobs):
             needed = gas_to_consume(m.blob_sizes, gas_per_byte)
             if ctx.tx.fee.gas_limit < needed:
@@ -236,7 +286,7 @@ def blob_share_limit(ctx: AnteContext) -> None:
     hard_max = square_size_upper_bound(ctx.app_version)
     k = min(gov_max, hard_max)
     max_shares = k * k
-    for m in ctx.tx.msgs:
+    for m in flat_msgs(ctx.tx):
         if isinstance(m, MsgPayForBlobs):
             total = sum(sparse_shares_needed(s) for s in m.blob_sizes)
             if total > max_shares:
@@ -251,7 +301,7 @@ def gov_param_filter(ctx: AnteContext) -> None:
     from celestia_tpu.state.params import ParamBlockList
 
     block_list = ParamBlockList()
-    for m in ctx.tx.msgs:
+    for m in flat_msgs(ctx.tx):
         if isinstance(m, MsgParamChange):
             block_list.validate_change(m.subspace, m.key)
 
@@ -262,6 +312,7 @@ DEFAULT_ANTE_CHAIN: List[Callable[[AnteContext], None]] = [
     check_timeout_height,
     consume_tx_size_gas,
     check_and_deduct_fee,
+    validate_sig_count,
     verify_signature,
     increment_sequence,
     min_gas_pfb,

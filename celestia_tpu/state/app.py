@@ -33,6 +33,7 @@ from celestia_tpu.state.ante import AnteContext, AnteError, GasMeter, run_ante
 from celestia_tpu.state.auth import AccountKeeper
 from celestia_tpu.state.bank import BankKeeper, FEE_COLLECTOR
 from celestia_tpu.state.modules.blob import BlobKeeper, validate_blob_tx
+from celestia_tpu.state.modules.feegrant import FeeGrantKeeper
 from celestia_tpu.state.modules.blobstream import BlobstreamKeeper
 from celestia_tpu.state.modules.mint import MintKeeper
 from celestia_tpu.state.modules.upgrade import UpgradeKeeper
@@ -41,16 +42,29 @@ from celestia_tpu.state.staking import StakingKeeper
 from celestia_tpu.state.store import MultiStore
 from celestia_tpu.state.tx import (
     Msg,
+    MsgAuthzGrant,
+    MsgAuthzRevoke,
+    MsgCreateVestingAccount,
     MsgDelegate,
+    MsgExec,
+    MsgFundCommunityPool,
+    MsgGrantAllowance,
     MsgParamChange,
     MsgPayForBlobs,
     MsgRegisterEVMAddress,
+    MsgRevokeAllowance,
     MsgSend,
+    MsgSetWithdrawAddress,
     MsgSignalVersion,
+    MsgSubmitEvidence,
     MsgSubmitProposal,
     MsgTryUpgrade,
     MsgUndelegate,
+    MsgUnjail,
+    MsgVerifyInvariant,
     MsgVote,
+    MsgWithdrawDelegatorReward,
+    MsgWithdrawValidatorCommission,
     Tx,
     unmarshal_tx,
 )
@@ -58,7 +72,8 @@ from celestia_tpu.utils.telemetry import Telemetry
 
 STORE_NAMES = [
     "auth", "bank", "staking", "params", "blob", "upgrade", "blobstream",
-    "mint", "gov", "meta",
+    "mint", "gov", "meta", "feegrant", "authz", "distribution", "slashing",
+    "evidence",
 ]
 
 _APP_VERSION_KEY = b"app_version"
@@ -120,6 +135,20 @@ class App:
             self.store.store("blobstream"), self.staking, self.params
         )
         self.mint = MintKeeper(self.store.store("mint"), self.bank)
+        from celestia_tpu.state.modules.authz import AuthzKeeper
+        from celestia_tpu.state.modules.distribution import DistributionKeeper
+
+        self.feegrant = FeeGrantKeeper(self.store.store("feegrant"))
+        self.authz = AuthzKeeper(self.store.store("authz"))
+        self.distribution = DistributionKeeper(
+            self.store.store("distribution"), self.bank, self.staking
+        )
+        self.distribution.register_hooks()
+        from celestia_tpu.state.modules.evidence import EvidenceKeeper
+        from celestia_tpu.state.modules.slashing import SlashingKeeper
+
+        self.slashing = SlashingKeeper(self.store.store("slashing"), self.staking)
+        self.evidence = EvidenceKeeper(self.store.store("evidence"), self.slashing)
         self.param_block_list = ParamBlockList()
         from celestia_tpu.state.modules.gov import GovKeeper
 
@@ -132,7 +161,7 @@ class App:
         from celestia_tpu.state.modules.ibc import IBCStack
 
         self.ibc = IBCStack(
-            name=self.chain_id, bank=self.bank, filtered=True
+            name=self.chain_id, bank=self.bank, filtered=True, app=self
         )
 
     # ------------------------------------------------------------------
@@ -238,6 +267,8 @@ class App:
                 is_recheck=is_recheck,
                 min_gas_price=self.min_gas_price,
                 height=self.next_height(),
+                feegrant=FeeGrantKeeper(branch.store("feegrant")),
+                time_ns=self.block_time_ns,
             )
             meter = run_ante(ctx)
             check_state.write_back(branch)
@@ -319,6 +350,8 @@ class App:
                     app_version=self.app_version,
                     sig_ok=sig_ok,
                     height=self.next_height(),
+                    feegrant=FeeGrantKeeper(branch.store("feegrant")),
+                    time_ns=self.block_time_ns,
                 )
                 run_ante(ctx)
                 kept.append(raw)
@@ -377,6 +410,8 @@ class App:
                     app_version=self.app_version,
                     sig_ok=sig_ok,
                     height=self.next_height(),
+                    feegrant=FeeGrantKeeper(branch.store("feegrant")),
+                    time_ns=self.block_time_ns,
                 )
                 run_ante(ctx)
             # strict reconstruction
@@ -406,10 +441,27 @@ class App:
     # Block execution (Begin/Deliver/End/Commit)
     # ------------------------------------------------------------------
 
-    def begin_block(self, height: int, time_ns: int) -> None:
+    def begin_block(
+        self,
+        height: int,
+        time_ns: int,
+        proposer: Optional[bytes] = None,
+        votes: Optional[List[Tuple[bytes, bool]]] = None,
+    ) -> None:
+        """BeginBlocker: mint this block's provision, then allocate the fee
+        collector (previous block's fees + the fresh provision) through
+        x/distribution using the previous commit's proposer/votes — the SDK
+        mint-before-distribution BeginBlock order."""
         self.block_time_ns = time_ns
         self.block_height = height
+        # the deterministic clock vesting locks are evaluated at — every
+        # state branch (check/ante/deliver) reads it from the bank store
+        self.bank.set_block_time(time_ns)
         self.mint.begin_blocker(time_ns)
+        self.distribution.allocate_tokens(proposer, votes)
+        if votes is not None:
+            # liveness window update + downtime jailing (slashing BeginBlocker)
+            self.slashing.begin_blocker(votes, height, time_ns)
 
     def deliver_tx(self, raw: bytes) -> TxResult:
         """Execute one block tx (blob txs execute their inner PFB only —
@@ -434,6 +486,8 @@ class App:
             chain_id=self.chain_id,
             app_version=self.app_version,
             height=self.next_height(),
+            feegrant=FeeGrantKeeper(ante_branch.store("feegrant")),
+            time_ns=self.block_time_ns,
         )
         try:
             meter = run_ante(ctx)
@@ -499,6 +553,113 @@ class App:
         if isinstance(msg, MsgVote):
             self.gov.vote(msg, self.block_height)
             return {"type": "vote", "proposal_id": msg.proposal_id}
+        if isinstance(msg, MsgGrantAllowance):
+            from celestia_tpu.state.modules.feegrant import Allowance
+
+            self.feegrant.grant(
+                msg.granter,
+                msg.grantee,
+                Allowance(
+                    kind=msg.kind,
+                    spend_limit=msg.spend_limit,
+                    expiration_ns=msg.expiration_ns,
+                    period_ns=msg.period_ns,
+                    period_spend_limit=msg.period_spend_limit,
+                ),
+            )
+            return {"type": "grant_allowance"}
+        if isinstance(msg, MsgRevokeAllowance):
+            self.feegrant.revoke(msg.granter, msg.grantee)
+            return {"type": "revoke_allowance"}
+        if isinstance(msg, MsgAuthzGrant):
+            from celestia_tpu.state.modules.authz import Authorization
+
+            self.authz.grant(
+                msg.granter,
+                msg.grantee,
+                Authorization(
+                    msg_type=msg.msg_type,
+                    spend_limit=msg.spend_limit,
+                    expiration_ns=msg.expiration_ns,
+                ),
+            )
+            return {"type": "authz_grant"}
+        if isinstance(msg, MsgAuthzRevoke):
+            self.authz.revoke(msg.granter, msg.grantee, msg.msg_type)
+            return {"type": "authz_revoke"}
+        if isinstance(msg, MsgWithdrawDelegatorReward):
+            amount = self.distribution.withdraw_delegator_reward(
+                msg.delegator, msg.validator
+            )
+            return {"type": "withdraw_rewards", "amount": amount}
+        if isinstance(msg, MsgWithdrawValidatorCommission):
+            amount = self.distribution.withdraw_validator_commission(msg.validator)
+            return {"type": "withdraw_commission", "amount": amount}
+        if isinstance(msg, MsgFundCommunityPool):
+            self.distribution.fund_community_pool(msg.depositor, msg.amount)
+            return {"type": "fund_community_pool", "amount": msg.amount}
+        if isinstance(msg, MsgSetWithdrawAddress):
+            self.distribution.set_withdraw_address(
+                msg.delegator, msg.withdraw_address
+            )
+            return {"type": "set_withdraw_address"}
+        if isinstance(msg, MsgUnjail):
+            self.slashing.unjail(msg.validator, self.block_time_ns)
+            return {"type": "unjail"}
+        if isinstance(msg, MsgSubmitEvidence):
+            from celestia_tpu.state.modules.evidence import Equivocation
+
+            # the msg path is permissionless, so the evidence must PROVE
+            # the double-sign against the validator's registered pubkey
+            val_acc = self.accounts.get(msg.validator)
+            slashed = self.evidence.submit(
+                Equivocation(
+                    msg.validator, msg.height, msg.time_ns,
+                    msg.block_hash_a, msg.sig_a,
+                    msg.block_hash_b, msg.sig_b,
+                ),
+                self.block_height,
+                self.block_time_ns,
+                chain_id=self.chain_id,
+                pubkey=val_acc.pubkey if val_acc else b"",
+            )
+            return {"type": "submit_evidence", "slashed": slashed}
+        if isinstance(msg, MsgVerifyInvariant):
+            from celestia_tpu.state.invariants import (
+                DEFAULT_INVARIANTS,
+                GAS_COST_PER_INVARIANT,
+                assert_invariants,
+            )
+
+            names = [msg.invariant] if msg.invariant else None
+            gas_meter.consume(
+                GAS_COST_PER_INVARIANT
+                * (len(names) if names else len(DEFAULT_INVARIANTS)),
+                "verify invariant",
+            )
+            results = assert_invariants(self, names)
+            return {"type": "verify_invariant", "results": results}
+        if isinstance(msg, MsgCreateVestingAccount):
+            # fund a fresh account under a vesting schedule (the SDK's
+            # MsgCreateVestingAccount: start = block time)
+            self.bank.set_vesting_schedule(
+                msg.to_addr, msg.amount, self.block_time_ns,
+                msg.end_time_ns, msg.delayed,
+            )
+            self.bank.send(msg.from_addr, msg.to_addr, msg.amount)
+            self.accounts.get_or_create(msg.to_addr)
+            return {"type": "create_vesting_account", "amount": msg.amount}
+        if isinstance(msg, MsgExec):
+            inner_events = []
+            for im in msg.inner:
+                # every inner signer must have granted the grantee this
+                # message type (authz MsgExec dispatch)
+                for signer in im.signers():
+                    self.authz.check_and_consume(
+                        signer, msg.grantee, im, self.block_time_ns
+                    )
+                inner_events.append(self._execute_msg(im, gas_meter))
+            return {"type": "exec", "inner": inner_events}
         raise ValueError(f"no handler for message {type(msg).__name__}")
 
     def end_block(self, height: int, time_ns: int) -> dict:
@@ -539,11 +700,13 @@ class App:
         height: int,
         time_ns: int,
         data_root: bytes,
+        proposer: Optional[bytes] = None,
+        votes: Optional[List[Tuple[bytes, bool]]] = None,
     ) -> Tuple[List[TxResult], dict, bytes]:
         """Begin -> deliver all -> end -> record data root -> commit.
 
         Returns (tx results, end-block response, app hash)."""
-        self.begin_block(height, time_ns)
+        self.begin_block(height, time_ns, proposer, votes)
         results = [self.deliver_tx(raw) for raw in block_txs]
         self.blobstream.record_data_root(height, data_root)
         end = self.end_block(height, time_ns)

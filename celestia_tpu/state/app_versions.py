@@ -18,16 +18,29 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from celestia_tpu.appconsts import V1_VERSION, V2_VERSION
 from celestia_tpu.state.tx import (
+    MsgAuthzGrant,
+    MsgAuthzRevoke,
+    MsgCreateVestingAccount,
     MsgDelegate,
+    MsgExec,
+    MsgFundCommunityPool,
+    MsgGrantAllowance,
     MsgParamChange,
     MsgPayForBlobs,
     MsgRegisterEVMAddress,
+    MsgRevokeAllowance,
     MsgSend,
+    MsgSetWithdrawAddress,
     MsgSignalVersion,
+    MsgSubmitEvidence,
     MsgSubmitProposal,
     MsgTryUpgrade,
     MsgUndelegate,
+    MsgUnjail,
+    MsgVerifyInvariant,
     MsgVote,
+    MsgWithdrawDelegatorReward,
+    MsgWithdrawValidatorCommission,
 )
 
 INF_VERSION = 1 << 30  # "open-ended" ToVersion
@@ -150,6 +163,32 @@ DEFAULT_MODULES: Tuple[VersionedModule, ...] = (
     VersionedModule("mint", V1_VERSION),
     VersionedModule("paramfilter", V1_VERSION),
     VersionedModule("tokenfilter", V1_VERSION),
+    VersionedModule(
+        "feegrant",
+        V1_VERSION,
+        msg_types=(MsgGrantAllowance, MsgRevokeAllowance),
+    ),
+    VersionedModule(
+        "authz",
+        V1_VERSION,
+        msg_types=(MsgAuthzGrant, MsgAuthzRevoke, MsgExec),
+    ),
+    VersionedModule(
+        "distribution",
+        V1_VERSION,
+        msg_types=(
+            MsgWithdrawDelegatorReward,
+            MsgWithdrawValidatorCommission,
+            MsgFundCommunityPool,
+            MsgSetWithdrawAddress,
+        ),
+    ),
+    VersionedModule("slashing", V1_VERSION, msg_types=(MsgUnjail,)),
+    VersionedModule("evidence", V1_VERSION, msg_types=(MsgSubmitEvidence,)),
+    VersionedModule("crisis", V1_VERSION, msg_types=(MsgVerifyInvariant,)),
+    VersionedModule(
+        "vesting", V1_VERSION, msg_types=(MsgCreateVestingAccount,)
+    ),
     # x/upgrade signalling arrives in v2 (ADR-018); x/minfee's param
     # subspace is created by its v2 migration
     VersionedModule(

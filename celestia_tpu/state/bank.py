@@ -1,19 +1,31 @@
-"""x/bank equivalent: balances, transfers, module accounts, supply.
+"""x/bank equivalent: balances, transfers, module accounts, supply, and
+vesting-account lock enforcement.
 
 Parity role: cosmos-sdk bank keeper (fee deduction in the ante chain, mint
 module provisioning, staking bonding — SURVEY.md §2.1).  Single native denom
 ``utia`` (appconsts.BondDenom).
+
+Vesting (auth/vesting parity): a vesting schedule stored against an address
+locks part of its balance; `send` rejects spends of locked coins.  The
+block time the locks are evaluated at is written INTO the bank store by the
+App's BeginBlocker, so every branch (check state, ante branch, deliver
+branch) sees the same deterministic clock — a wall-clock read here would
+fork app hashes between validators.  Like the SDK, delegating locked coins
+is allowed (sends to the bonded pool bypass the lock).
 """
 
 from __future__ import annotations
 
 import hashlib
-from typing import Dict
+from typing import Dict, Optional, Tuple
 
+from celestia_tpu.da.shares import _read_varint, _varint
 from celestia_tpu.state.store import KVStore
 
 _BALANCE_PREFIX = b"bal/"
 _SUPPLY_KEY = b"supply"
+_VESTING_PREFIX = b"vest/"
+_BLOCK_TIME_KEY = b"block_time_ns"
 
 
 def module_address(name: str) -> bytes:
@@ -55,6 +67,13 @@ class BankKeeper:
             raise ValueError(
                 f"insufficient funds: balance {bal}utia < {amount}utia"
             )
+        if to_addr != BONDED_POOL:  # delegating locked coins is allowed
+            locked = self.locked(from_addr)
+            if bal - amount < locked:
+                raise ValueError(
+                    f"insufficient spendable funds: balance {bal}utia has "
+                    f"{locked}utia still vesting"
+                )
         self._set_balance(from_addr, bal - amount)
         self._set_balance(to_addr, self.balance(to_addr) + amount)
 
@@ -75,6 +94,68 @@ class BankKeeper:
             k[len(_BALANCE_PREFIX):]: int.from_bytes(v, "big")
             for k, v in self.store.iterate(_BALANCE_PREFIX)
         }
+
+    # -- vesting accounts ----------------------------------------------
+    #
+    # schedule record: (original_vesting, start_ns, end_ns, delayed)
+    # delayed=1: everything locked until end (DelayedVestingAccount);
+    # delayed=0: linear release between start and end (ContinuousVesting).
+
+    def set_block_time(self, now_ns: int) -> None:
+        """Called by the App's BeginBlocker; the deterministic clock every
+        lock evaluation uses."""
+        self.store.set(_BLOCK_TIME_KEY, now_ns.to_bytes(8, "big"))
+
+    def block_time(self) -> int:
+        raw = self.store.get(_BLOCK_TIME_KEY)
+        return int.from_bytes(raw, "big") if raw else 0
+
+    def vesting_schedule(
+        self, addr: bytes
+    ) -> Optional[Tuple[int, int, int, bool]]:
+        raw = self.store.get(_VESTING_PREFIX + addr)
+        if raw is None:
+            return None
+        orig, pos = _read_varint(raw, 0)
+        start, pos = _read_varint(raw, pos)
+        end, pos = _read_varint(raw, pos)
+        delayed, pos = _read_varint(raw, pos)
+        return orig, start, end, bool(delayed)
+
+    def set_vesting_schedule(
+        self, addr: bytes, original: int, start_ns: int, end_ns: int,
+        delayed: bool,
+    ) -> None:
+        if self.vesting_schedule(addr) is not None:
+            raise ValueError("account already has a vesting schedule")
+        if end_ns <= start_ns or original <= 0:
+            raise ValueError("invalid vesting schedule")
+        self.store.set(
+            _VESTING_PREFIX + addr,
+            bytes(
+                _varint(original) + _varint(start_ns) + _varint(end_ns)
+                + _varint(1 if delayed else 0)
+            ),
+        )
+
+    def locked(self, addr: bytes) -> int:
+        """Still-vesting amount at the current block time; fully-vested
+        schedules are pruned on touch."""
+        sched = self.vesting_schedule(addr)
+        if sched is None:
+            return 0
+        original, start, end, delayed = sched
+        now = self.block_time()
+        if now >= end:
+            self.store.delete(_VESTING_PREFIX + addr)
+            return 0
+        if delayed or now <= start:
+            return original
+        # continuous: linear release over [start, end]
+        return original * (end - now) // (end - start)
+
+    def spendable(self, addr: bytes) -> int:
+        return max(0, self.balance(addr) - self.locked(addr))
 
     # -- multi-denom (IBC vouchers) ------------------------------------
     #

@@ -50,6 +50,9 @@ class Proposal:
     voting_end_height: int
     status: int = PROPOSAL_STATUS_VOTING
     result_log: str = ""
+    # community-pool spend content (distribution CommunityPoolSpendProposal)
+    spend_to: bytes = b""
+    spend_amount: int = 0
 
     def to_json(self) -> bytes:
         return json.dumps(
@@ -66,6 +69,8 @@ class Proposal:
                 "voting_end_height": self.voting_end_height,
                 "status": self.status,
                 "result_log": self.result_log,
+                "spend_to": self.spend_to.hex(),
+                "spend_amount": self.spend_amount,
             }
         ).encode()
 
@@ -85,6 +90,8 @@ class Proposal:
             voting_end_height=d["voting_end_height"],
             status=d["status"],
             result_log=d.get("result_log", ""),
+            spend_to=bytes.fromhex(d.get("spend_to", "")),
+            spend_amount=d.get("spend_amount", 0),
         )
 
 
@@ -113,8 +120,11 @@ class GovKeeper:
     # -- submission / voting -------------------------------------------
 
     def submit_proposal(self, msg: MsgSubmitProposal, height: int) -> int:
-        if not msg.changes:
-            raise ValueError("proposal carries no param changes")
+        spend_amount = getattr(msg, "spend_amount", 0)
+        if not msg.changes and not spend_amount:
+            raise ValueError("proposal carries no content")
+        if spend_amount and len(getattr(msg, "spend_to", b"")) != 20:
+            raise ValueError("community-pool spend needs a 20-byte recipient")
         if msg.deposit < self.min_deposit():
             raise ValueError(
                 f"deposit {msg.deposit} below minimum {self.min_deposit()}"
@@ -135,6 +145,8 @@ class GovKeeper:
             deposit=msg.deposit,
             submit_height=height,
             voting_end_height=height + self.voting_period(),
+            spend_to=getattr(msg, "spend_to", b""),
+            spend_amount=spend_amount,
         )
         self._put(prop)
         return pid
@@ -232,11 +244,23 @@ class GovKeeper:
 
     def _execute(self, prop: Proposal, app) -> None:
         """GovHandler parity (gov_handler.go:36-60): validate EVERY change
-        against the blocklist before applying ANY."""
+        against the blocklist before applying ANY; a community-pool spend
+        that cannot be covered refuses the whole proposal."""
         for subspace, key, _ in prop.changes:
             self.block_list.validate_change(subspace, key)
+        if prop.spend_amount:
+            pool = app.distribution.community_pool()
+            if prop.spend_amount > pool:
+                raise ValueError(
+                    f"community pool {pool}utia cannot cover spend "
+                    f"{prop.spend_amount}utia"
+                )
         for subspace, key, value in prop.changes:
             app.params.set(subspace, key, json.loads(value))
+        if prop.spend_amount:
+            app.distribution.spend_community_pool(
+                prop.spend_to, prop.spend_amount
+            )
 
     # -- storage -------------------------------------------------------
 

@@ -59,6 +59,9 @@ class ChannelKeeper:
         self._next_seq: Dict[str, int] = {}
         self.commitments: Dict[Tuple[str, int], bytes] = {}
         self.acks: Dict[Tuple[str, int], Acknowledgement] = {}
+        # outbound log relayers drain (packet-forward hops emit sends the
+        # caller never sees, so the transport surfaces them here)
+        self.sent: List[Tuple[Packet, int]] = []
 
     def open_channel(
         self, channel_id: str, counterparty_channel: str,
@@ -83,6 +86,7 @@ class ChannelKeeper:
             data=data,
         )
         self.commitments[(channel_id, seq)] = hashlib.sha256(data).digest()
+        self.sent.append((packet, seq))
         return packet, seq
 
     def write_ack(self, channel_id: str, seq: int, ack: Acknowledgement) -> None:
@@ -201,18 +205,194 @@ class TokenFilterMiddleware:
         return getattr(self.app, name)
 
 
+def forward_address(channel: str, receiver: str) -> bytes:
+    """Deterministic intermediate account the forward hop settles through
+    (packet-forward-middleware derives one the same way)."""
+    return hashlib.sha256(f"pfm-intermediate/{channel}/{receiver}".encode()).digest()[:20]
+
+
+class PacketForwardMiddleware:
+    """packet-forward-middleware parity (the reference wires
+    PacketForwardKeeper, app/app.go:219): an inbound ICS-20 packet whose
+    memo carries {"forward": {"receiver", "channel"}} is received into a
+    deterministic intermediate account and immediately re-sent out the
+    requested channel toward the final receiver.  A failed onward send
+    refunds by acking the ORIGINAL packet as an error, so the upstream
+    chain's own refund path fires — the same fail-safe the real PFM uses."""
+
+    def __init__(self, app_module, transfer: TransferModule):
+        self.app = app_module  # next layer inward (e.g. token filter)
+        self.transfer = transfer  # for the onward hop
+
+    def on_recv_packet(self, packet: Packet) -> Acknowledgement:
+        try:
+            data = FungibleTokenPacketData.from_json(packet.data)
+            memo = json.loads(data.memo) if data.memo else {}
+        except (ValueError, KeyError):
+            return self.app.on_recv_packet(packet)
+        fwd = memo.get("forward") if isinstance(memo, dict) else None
+        if not fwd:
+            return self.app.on_recv_packet(packet)
+        try:
+            final_receiver = fwd["receiver"]
+            out_channel = fwd["channel"]
+        except (KeyError, TypeError):
+            return Acknowledgement(False, "malformed forward memo")
+        # hop 1: receive into the intermediate account via the inner stack
+        # (token filter still applies — a forbidden token never forwards)
+        intermediate = forward_address(out_channel, final_receiver)
+        hop_packet = Packet(
+            packet.source_port, packet.source_channel,
+            packet.dest_port, packet.dest_channel,
+            FungibleTokenPacketData(
+                data.denom, data.amount, data.sender, intermediate.hex(),
+            ).to_json(),
+        )
+        ack = self.app.on_recv_packet(hop_packet)
+        if not ack.success:
+            return ack
+        # hop 2: send onward; the denom as held HERE gains/loses the hop
+        # prefix exactly as the transfer module's receive computed it
+        prefix = f"{packet.source_port}/{packet.source_channel}/"
+        if data.denom.startswith(prefix):
+            local_denom = data.denom[len(prefix):]
+        else:
+            local_denom = f"{packet.dest_port}/{packet.dest_channel}/{data.denom}"
+        try:
+            self.transfer.send_transfer(
+                intermediate, final_receiver, int(data.amount),
+                local_denom, out_channel,
+            )
+        except ValueError as e:
+            # onward hop failed: error-acking the original makes the sender
+            # chain refund, so the hop-1 credit must leave circulation HERE
+            # or the tokens exist on both chains (supply inflation)
+            amount = int(data.amount)
+            if data.denom.startswith(prefix):
+                # hop 1 unescrowed a returning token: re-escrow it
+                self.transfer.bank.send_denom(
+                    intermediate,
+                    escrow_address(packet.dest_port, packet.dest_channel),
+                    amount, local_denom,
+                )
+            else:
+                # hop 1 minted a voucher: burn it
+                self.transfer.bank.burn_denom(intermediate, amount, local_denom)
+            return Acknowledgement(False, f"forward failed: {e}")
+        return Acknowledgement(True)
+
+    def __getattr__(self, name):
+        return getattr(self.app, name)
+
+
+ICA_HOST_PORT = "icahost"
+
+
+def interchain_account_address(connection: str, owner: str) -> bytes:
+    """Deterministic ICS-27 interchain account address for (connection,
+    controller-side owner)."""
+    return hashlib.sha256(
+        f"ics27-account/{connection}/{owner}".encode()
+    ).digest()[:20]
+
+
+class ICAHostModule:
+    """ICS-27 host parity (the reference wires ICAHostKeeper,
+    app/app.go:203): executes transactions sent by a counterparty
+    controller chain under that controller's interchain account.
+
+    Packet data: {"type": "ica_tx", "owner": ..., "connection": ...,
+    "msgs": [hex-marshaled msgs]}.  Every msg's declared signer must BE the
+    derived interchain account — a controller can never act as anyone else.
+    Execution is atomic: any failure rolls back the whole packet and
+    returns an error ack."""
+
+    def __init__(self, app, allow_msgs: Optional[List[int]] = None):
+        self.app = app  # the state-machine App (msg dispatch + stores)
+        # host-side allowlist of msg TYPE ids (SDK ica host AllowMessages);
+        # None = allow all registered msgs
+        self.allow_msgs = allow_msgs
+
+    def on_recv_packet(self, packet: Packet) -> Acknowledgement:
+        from celestia_tpu.state.ante import GasMeter
+        from celestia_tpu.state.tx import unmarshal_msg
+
+        try:
+            d = json.loads(packet.data)
+            assert d.get("type") == "ica_tx"
+            owner = d["owner"]
+            connection = d["connection"]
+            raw_msgs = [bytes.fromhex(m) for m in d["msgs"]]
+        except (ValueError, KeyError, AssertionError):
+            return Acknowledgement(False, "cannot unmarshal ICS-27 packet data")
+        ica_addr = interchain_account_address(connection, owner)
+        msgs = []
+        try:
+            for raw in raw_msgs:
+                msg, used = unmarshal_msg(raw)
+                if used != len(raw):
+                    raise ValueError("trailing bytes in ICA msg")
+                msgs.append(msg)
+        except ValueError as e:
+            return Acknowledgement(False, f"bad ICA msg: {e}")
+        for msg in msgs:
+            if self.allow_msgs is not None and msg.TYPE not in self.allow_msgs:
+                return Acknowledgement(
+                    False, f"msg type {msg.TYPE} not allowed on this host"
+                )
+            if any(s != ica_addr for s in msg.signers()):
+                return Acknowledgement(
+                    False, "ICA msg signer is not the interchain account"
+                )
+        # atomic execution on a branch (ibc-go's cache-ctx commit shape)
+        branch = self.app.store.branch()
+        saved = self.app.store
+        self.app.store = branch
+        self.app._wire_keepers()
+        try:
+            meter = GasMeter(10_000_000)
+            for msg in msgs:
+                self.app._execute_msg(msg, meter)
+        except Exception as e:
+            return Acknowledgement(False, f"ICA execution failed: {e}")
+        else:
+            saved.write_back(branch)
+            return Acknowledgement(True)
+        finally:
+            self.app.store = saved
+            self.app._wire_keepers()
+
+
 @dataclass
 class IBCStack:
-    """One chain's transfer stack: channels + (possibly wrapped) module."""
+    """One chain's transfer stack: channels + middleware-wrapped module.
+
+    Stack order (outermost first) mirrors the reference's app.go wiring:
+    packet-forward -> token filter -> ICS-20 transfer; the ICS-27 host
+    module listens on its own port when an App is attached."""
 
     name: str
     bank: object
     channels: ChannelKeeper = field(default_factory=ChannelKeeper)
     filtered: bool = False
+    forwarding: bool = True
+    app: object = None  # the state-machine App (enables the ICA host)
 
     def __post_init__(self):
-        module = TransferModule(self.bank, self.channels, self.name)
-        self.module = TokenFilterMiddleware(module) if self.filtered else module
+        transfer = TransferModule(self.bank, self.channels, self.name)
+        module = TokenFilterMiddleware(transfer) if self.filtered else transfer
+        if self.forwarding:
+            module = PacketForwardMiddleware(module, transfer)
+        self.module = module
+        self.ica_host = ICAHostModule(self.app) if self.app is not None else None
+
+    def on_recv_packet(self, packet: Packet) -> Acknowledgement:
+        """Port-level dispatch (IBC router role)."""
+        if packet.dest_port == ICA_HOST_PORT:
+            if self.ica_host is None:
+                return Acknowledgement(False, "ICA host not enabled")
+            return self.ica_host.on_recv_packet(packet)
+        return self.module.on_recv_packet(packet)
 
 
 class Relayer:
@@ -227,7 +407,7 @@ class Relayer:
 
     def relay(self, src: IBCStack, packet: Packet, seq: int) -> Acknowledgement:
         dst = self.b if src is self.a else self.a
-        ack = dst.module.on_recv_packet(packet)
+        ack = dst.on_recv_packet(packet)  # port-level router (ICA vs ICS-20)
         dst.channels.write_ack(packet.dest_channel, seq, ack)
         src.module.on_acknowledgement(packet, seq, ack)
         return ack

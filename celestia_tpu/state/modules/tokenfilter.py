@@ -22,17 +22,21 @@ NATIVE_DENOM = "utia"
 
 @dataclass(frozen=True)
 class FungibleTokenPacketData:
-    """ICS-20 packet payload."""
+    """ICS-20 packet payload (memo carries packet-forward instructions)."""
 
     denom: str
     amount: str
     sender: str
     receiver: str
+    memo: str = ""
 
     @classmethod
     def from_json(cls, raw: bytes) -> "FungibleTokenPacketData":
         d = json.loads(raw)
-        return cls(d["denom"], d["amount"], d["sender"], d["receiver"])
+        return cls(
+            d["denom"], d["amount"], d["sender"], d["receiver"],
+            d.get("memo", ""),
+        )
 
     def to_json(self) -> bytes:
         return json.dumps(
@@ -41,6 +45,7 @@ class FungibleTokenPacketData:
                 "amount": self.amount,
                 "sender": self.sender,
                 "receiver": self.receiver,
+                "memo": self.memo,
             },
             sort_keys=True,
         ).encode()

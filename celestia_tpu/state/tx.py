@@ -158,9 +158,10 @@ class MsgParamChange:
 
 @dataclass(frozen=True)
 class MsgSubmitProposal:
-    """Submit a governance proposal carrying param changes (x/gov submit +
-    ParamChangeProposal content; executed through the blocklist-gated
-    handler, x/paramfilter/gov_handler.go:36-60)."""
+    """Submit a governance proposal: param changes (ParamChangeProposal,
+    executed through the blocklist-gated handler,
+    x/paramfilter/gov_handler.go:36-60) and/or a community-pool spend
+    (distribution CommunityPoolSpendProposal)."""
 
     proposer: bytes
     title: str
@@ -168,6 +169,9 @@ class MsgSubmitProposal:
     # each change: (subspace, key, json-encoded value)
     changes: Tuple[Tuple[str, str, bytes], ...]
     deposit: int
+    # community-pool spend (0 amount = none)
+    spend_to: bytes = b""
+    spend_amount: int = 0
 
     TYPE = 9
 
@@ -194,6 +198,196 @@ class MsgVote:
         return [self.voter]
 
 
+@dataclass(frozen=True)
+class MsgGrantAllowance:
+    """x/feegrant: grant a fee allowance (basic or periodic)."""
+
+    granter: bytes
+    grantee: bytes
+    kind: int  # feegrant.KIND_BASIC / KIND_PERIODIC
+    spend_limit: int  # 0 = unlimited
+    expiration_ns: int  # 0 = never
+    period_ns: int = 0
+    period_spend_limit: int = 0
+
+    TYPE = 11
+
+    def signers(self) -> List[bytes]:
+        return [self.granter]
+
+
+@dataclass(frozen=True)
+class MsgRevokeAllowance:
+    """x/feegrant: revoke a fee allowance."""
+
+    granter: bytes
+    grantee: bytes
+
+    TYPE = 12
+
+    def signers(self) -> List[bytes]:
+        return [self.granter]
+
+
+@dataclass(frozen=True)
+class MsgAuthzGrant:
+    """x/authz: authorize a grantee to execute a message type."""
+
+    granter: bytes
+    grantee: bytes
+    msg_type: int  # Msg.TYPE id of the authorized message
+    spend_limit: int  # 0 = unlimited (generic authorization)
+    expiration_ns: int  # 0 = never
+
+    TYPE = 13
+
+    def signers(self) -> List[bytes]:
+        return [self.granter]
+
+
+@dataclass(frozen=True)
+class MsgAuthzRevoke:
+    """x/authz: revoke an authorization."""
+
+    granter: bytes
+    grantee: bytes
+    msg_type: int
+
+    TYPE = 14
+
+    def signers(self) -> List[bytes]:
+        return [self.granter]
+
+
+@dataclass(frozen=True)
+class MsgExec:
+    """x/authz: execute wrapped messages under existing grants.  The tx is
+    signed by the grantee; each inner message's declared signer must have
+    granted the matching authorization."""
+
+    grantee: bytes
+    inner: Tuple["Msg", ...]
+
+    TYPE = 15
+
+    def signers(self) -> List[bytes]:
+        return [self.grantee]
+
+
+@dataclass(frozen=True)
+class MsgWithdrawDelegatorReward:
+    """x/distribution: withdraw accrued delegation rewards."""
+
+    delegator: bytes
+    validator: bytes
+
+    TYPE = 16
+
+    def signers(self) -> List[bytes]:
+        return [self.delegator]
+
+
+@dataclass(frozen=True)
+class MsgWithdrawValidatorCommission:
+    """x/distribution: withdraw a validator's accrued commission."""
+
+    validator: bytes
+
+    TYPE = 17
+
+    def signers(self) -> List[bytes]:
+        return [self.validator]
+
+
+@dataclass(frozen=True)
+class MsgFundCommunityPool:
+    """x/distribution: move own funds into the community pool."""
+
+    depositor: bytes
+    amount: int
+
+    TYPE = 18
+
+    def signers(self) -> List[bytes]:
+        return [self.depositor]
+
+
+@dataclass(frozen=True)
+class MsgSetWithdrawAddress:
+    """x/distribution: set the address rewards are withdrawn to."""
+
+    delegator: bytes
+    withdraw_address: bytes
+
+    TYPE = 19
+
+    def signers(self) -> List[bytes]:
+        return [self.delegator]
+
+
+@dataclass(frozen=True)
+class MsgUnjail:
+    """x/slashing: a jailed validator rejoins after its jail duration."""
+
+    validator: bytes
+
+    TYPE = 20
+
+    def signers(self) -> List[bytes]:
+        return [self.validator]
+
+
+@dataclass(frozen=True)
+class MsgSubmitEvidence:
+    """x/evidence: submit equivocation (double-sign) evidence.  Carries the
+    two conflicting signed votes — the evidence must prove itself (the
+    msg path is open to anyone, unlike comet's pre-verified stream)."""
+
+    submitter: bytes
+    validator: bytes
+    height: int
+    time_ns: int
+    block_hash_a: bytes = b""
+    sig_a: bytes = b""
+    block_hash_b: bytes = b""
+    sig_b: bytes = b""
+
+    TYPE = 21
+
+    def signers(self) -> List[bytes]:
+        return [self.submitter]
+
+
+@dataclass(frozen=True)
+class MsgVerifyInvariant:
+    """x/crisis: force an on-chain invariant check (empty route = all)."""
+
+    sender: bytes
+    invariant: str = ""
+
+    TYPE = 22
+
+    def signers(self) -> List[bytes]:
+        return [self.sender]
+
+
+@dataclass(frozen=True)
+class MsgCreateVestingAccount:
+    """auth/vesting: fund a new account under a vesting schedule
+    (continuous by default; delayed locks everything until end_time)."""
+
+    from_addr: bytes
+    to_addr: bytes
+    amount: int
+    end_time_ns: int
+    delayed: bool = False
+
+    TYPE = 23
+
+    def signers(self) -> List[bytes]:
+        return [self.from_addr]
+
+
 Msg = Union[
     MsgSend,
     MsgPayForBlobs,
@@ -205,6 +399,19 @@ Msg = Union[
     MsgParamChange,
     MsgSubmitProposal,
     MsgVote,
+    MsgGrantAllowance,
+    MsgRevokeAllowance,
+    MsgAuthzGrant,
+    MsgAuthzRevoke,
+    MsgExec,
+    MsgWithdrawDelegatorReward,
+    MsgWithdrawValidatorCommission,
+    MsgFundCommunityPool,
+    MsgSetWithdrawAddress,
+    MsgUnjail,
+    MsgSubmitEvidence,
+    MsgVerifyInvariant,
+    MsgCreateVestingAccount,
 ]
 
 _MSG_TYPES = {
@@ -220,6 +427,19 @@ _MSG_TYPES = {
         MsgParamChange,
         MsgSubmitProposal,
         MsgVote,
+        MsgGrantAllowance,
+        MsgRevokeAllowance,
+        MsgAuthzGrant,
+        MsgAuthzRevoke,
+        MsgExec,
+        MsgWithdrawDelegatorReward,
+        MsgWithdrawValidatorCommission,
+        MsgFundCommunityPool,
+        MsgSetWithdrawAddress,
+        MsgUnjail,
+        MsgSubmitEvidence,
+        MsgVerifyInvariant,
+        MsgCreateVestingAccount,
     )
 }
 
@@ -268,10 +488,69 @@ def marshal_msg(msg: Msg) -> bytes:
             _put_bytes(out, key.encode())
             _put_bytes(out, val)
         out += _varint(msg.deposit)
+        _put_bytes(out, msg.spend_to)
+        out += _varint(msg.spend_amount)
     elif isinstance(msg, MsgVote):
         _put_bytes(out, msg.voter)
         out += _varint(msg.proposal_id)
         out += _varint(msg.option)
+    elif isinstance(msg, MsgGrantAllowance):
+        _put_bytes(out, msg.granter)
+        _put_bytes(out, msg.grantee)
+        out += _varint(msg.kind)
+        out += _varint(msg.spend_limit)
+        out += _varint(msg.expiration_ns)
+        out += _varint(msg.period_ns)
+        out += _varint(msg.period_spend_limit)
+    elif isinstance(msg, MsgRevokeAllowance):
+        _put_bytes(out, msg.granter)
+        _put_bytes(out, msg.grantee)
+    elif isinstance(msg, MsgAuthzGrant):
+        _put_bytes(out, msg.granter)
+        _put_bytes(out, msg.grantee)
+        out += _varint(msg.msg_type)
+        out += _varint(msg.spend_limit)
+        out += _varint(msg.expiration_ns)
+    elif isinstance(msg, MsgAuthzRevoke):
+        _put_bytes(out, msg.granter)
+        _put_bytes(out, msg.grantee)
+        out += _varint(msg.msg_type)
+    elif isinstance(msg, MsgExec):
+        _put_bytes(out, msg.grantee)
+        out += _varint(len(msg.inner))
+        for im in msg.inner:
+            _put_bytes(out, marshal_msg(im))
+    elif isinstance(msg, MsgWithdrawDelegatorReward):
+        _put_bytes(out, msg.delegator)
+        _put_bytes(out, msg.validator)
+    elif isinstance(msg, MsgWithdrawValidatorCommission):
+        _put_bytes(out, msg.validator)
+    elif isinstance(msg, MsgFundCommunityPool):
+        _put_bytes(out, msg.depositor)
+        out += _varint(msg.amount)
+    elif isinstance(msg, MsgSetWithdrawAddress):
+        _put_bytes(out, msg.delegator)
+        _put_bytes(out, msg.withdraw_address)
+    elif isinstance(msg, MsgUnjail):
+        _put_bytes(out, msg.validator)
+    elif isinstance(msg, MsgSubmitEvidence):
+        _put_bytes(out, msg.submitter)
+        _put_bytes(out, msg.validator)
+        out += _varint(msg.height)
+        out += _varint(msg.time_ns)
+        _put_bytes(out, msg.block_hash_a)
+        _put_bytes(out, msg.sig_a)
+        _put_bytes(out, msg.block_hash_b)
+        _put_bytes(out, msg.sig_b)
+    elif isinstance(msg, MsgVerifyInvariant):
+        _put_bytes(out, msg.sender)
+        _put_bytes(out, msg.invariant.encode())
+    elif isinstance(msg, MsgCreateVestingAccount):
+        _put_bytes(out, msg.from_addr)
+        _put_bytes(out, msg.to_addr)
+        out += _varint(msg.amount)
+        out += _varint(msg.end_time_ns)
+        out += _varint(1 if msg.delayed else 0)
     else:
         raise TypeError(f"unknown msg type {type(msg)}")
     return bytes(out)
@@ -338,9 +617,12 @@ def unmarshal_msg(raw: bytes, pos: int = 0) -> Tuple[Msg, int]:
             val, pos = _get_bytes(raw, pos)
             changes.append((sub.decode(), key.decode(), val))
         deposit, pos = _read_varint(raw, pos)
+        spend_to, pos = _get_bytes(raw, pos)
+        spend_amount, pos = _read_varint(raw, pos)
         return (
             MsgSubmitProposal(
-                proposer, title.decode(), desc.decode(), tuple(changes), deposit
+                proposer, title.decode(), desc.decode(), tuple(changes),
+                deposit, spend_to, spend_amount,
             ),
             pos,
         )
@@ -349,6 +631,85 @@ def unmarshal_msg(raw: bytes, pos: int = 0) -> Tuple[Msg, int]:
         pid, pos = _read_varint(raw, pos)
         opt, pos = _read_varint(raw, pos)
         return MsgVote(voter, pid, opt), pos
+    if t == MsgGrantAllowance.TYPE:
+        granter, pos = _get_bytes(raw, pos)
+        grantee, pos = _get_bytes(raw, pos)
+        kind, pos = _read_varint(raw, pos)
+        spend, pos = _read_varint(raw, pos)
+        exp, pos = _read_varint(raw, pos)
+        pns, pos = _read_varint(raw, pos)
+        plim, pos = _read_varint(raw, pos)
+        return MsgGrantAllowance(granter, grantee, kind, spend, exp, pns, plim), pos
+    if t == MsgRevokeAllowance.TYPE:
+        granter, pos = _get_bytes(raw, pos)
+        grantee, pos = _get_bytes(raw, pos)
+        return MsgRevokeAllowance(granter, grantee), pos
+    if t == MsgAuthzGrant.TYPE:
+        granter, pos = _get_bytes(raw, pos)
+        grantee, pos = _get_bytes(raw, pos)
+        mt, pos = _read_varint(raw, pos)
+        spend, pos = _read_varint(raw, pos)
+        exp, pos = _read_varint(raw, pos)
+        return MsgAuthzGrant(granter, grantee, mt, spend, exp), pos
+    if t == MsgAuthzRevoke.TYPE:
+        granter, pos = _get_bytes(raw, pos)
+        grantee, pos = _get_bytes(raw, pos)
+        mt, pos = _read_varint(raw, pos)
+        return MsgAuthzRevoke(granter, grantee, mt), pos
+    if t == MsgExec.TYPE:
+        grantee, pos = _get_bytes(raw, pos)
+        n, pos = _read_varint(raw, pos)
+        if n > 32:
+            raise ValueError("MsgExec carries too many inner messages")
+        inner = []
+        for _ in range(n):
+            imraw, pos = _get_bytes(raw, pos)
+            im, used = unmarshal_msg(imraw)
+            if used != len(imraw):
+                raise ValueError("trailing bytes in MsgExec inner msg")
+            if isinstance(im, MsgExec):
+                raise ValueError("nested MsgExec is not allowed")
+            inner.append(im)
+        return MsgExec(grantee, tuple(inner)), pos
+    if t == MsgWithdrawDelegatorReward.TYPE:
+        d, pos = _get_bytes(raw, pos)
+        v, pos = _get_bytes(raw, pos)
+        return MsgWithdrawDelegatorReward(d, v), pos
+    if t == MsgWithdrawValidatorCommission.TYPE:
+        v, pos = _get_bytes(raw, pos)
+        return MsgWithdrawValidatorCommission(v), pos
+    if t == MsgFundCommunityPool.TYPE:
+        d, pos = _get_bytes(raw, pos)
+        amt, pos = _read_varint(raw, pos)
+        return MsgFundCommunityPool(d, amt), pos
+    if t == MsgSetWithdrawAddress.TYPE:
+        d, pos = _get_bytes(raw, pos)
+        wa, pos = _get_bytes(raw, pos)
+        return MsgSetWithdrawAddress(d, wa), pos
+    if t == MsgUnjail.TYPE:
+        v, pos = _get_bytes(raw, pos)
+        return MsgUnjail(v), pos
+    if t == MsgSubmitEvidence.TYPE:
+        s, pos = _get_bytes(raw, pos)
+        v, pos = _get_bytes(raw, pos)
+        h, pos = _read_varint(raw, pos)
+        tns, pos = _read_varint(raw, pos)
+        bha, pos = _get_bytes(raw, pos)
+        siga, pos = _get_bytes(raw, pos)
+        bhb, pos = _get_bytes(raw, pos)
+        sigb, pos = _get_bytes(raw, pos)
+        return MsgSubmitEvidence(s, v, h, tns, bha, siga, bhb, sigb), pos
+    if t == MsgVerifyInvariant.TYPE:
+        s, pos = _get_bytes(raw, pos)
+        inv, pos = _get_bytes(raw, pos)
+        return MsgVerifyInvariant(s, inv.decode()), pos
+    if t == MsgCreateVestingAccount.TYPE:
+        f, pos = _get_bytes(raw, pos)
+        to, pos = _get_bytes(raw, pos)
+        amt, pos = _read_varint(raw, pos)
+        end, pos = _read_varint(raw, pos)
+        delayed, pos = _read_varint(raw, pos)
+        return MsgCreateVestingAccount(f, to, amt, end, bool(delayed)), pos
     raise ValueError(f"unknown msg type id {t}")
 
 
@@ -378,6 +739,9 @@ class Tx:
     # reject inclusion above this height; 0 = no timeout (the SDK's
     # TxTimeoutHeightDecorator field)
     timeout_height: int = 0
+    # x/feegrant: when set, this address's allowance pays the fee instead
+    # of the signer (SDK Fee.granter; covered by the signature)
+    fee_granter: bytes = b""
 
     def body_bytes(self) -> bytes:
         out = bytearray()
@@ -395,6 +759,7 @@ class Tx:
         _put_bytes(out, self.pubkey)
         out += _varint(self.sequence)
         out += _varint(self.account_number)
+        _put_bytes(out, self.fee_granter)
         return bytes(out)
 
     def sign_bytes(self, chain_id: str) -> bytes:
@@ -409,6 +774,7 @@ class Tx:
         return Tx(
             self.msgs, self.fee, self.pubkey, self.sequence,
             self.account_number, self.memo, sig, self.timeout_height,
+            self.fee_granter,
         )
 
     def is_multisig(self) -> bool:
@@ -471,9 +837,10 @@ def unmarshal_tx(raw: bytes) -> Tx:
     pubkey, apos = _get_bytes(auth, apos)
     sequence, apos = _read_varint(auth, apos)
     account_number, apos = _read_varint(auth, apos)
+    fee_granter, apos = _get_bytes(auth, apos)
     if apos != len(auth):
         raise ValueError("trailing bytes in tx auth")
     return Tx(
         tuple(msgs), Fee(fee_amount, gas_limit), pubkey, sequence,
-        account_number, memo_b.decode(), sig, timeout_height,
+        account_number, memo_b.decode(), sig, timeout_height, fee_granter,
     )

@@ -1,0 +1,190 @@
+"""x/distribution: fee allocation, F1 rewards, commission, community pool.
+
+Mirrors the reference's DistrKeeper wiring (app/app.go:303-306): community
+tax, proposer reward, per-validator commission, delegator rewards settled
+through staking hooks, withdraw messages.
+"""
+
+import pytest
+
+from celestia_tpu.state.app import App
+from celestia_tpu.state.bank import FEE_COLLECTOR
+from celestia_tpu.state.modules.distribution import (
+    COMMUNITY_TAX_PPM,
+    DISTRIBUTION_MODULE,
+    DistributionError,
+)
+from celestia_tpu.state.tx import (
+    Fee,
+    MsgDelegate,
+    MsgFundCommunityPool,
+    MsgSend,
+    MsgSetWithdrawAddress,
+    MsgWithdrawDelegatorReward,
+    MsgWithdrawValidatorCommission,
+    Tx,
+)
+from celestia_tpu.utils.secp256k1 import PrivateKey
+
+VAL_KEY = PrivateKey.from_seed(b"dist-val")
+DEL_KEY = PrivateKey.from_seed(b"dist-del")
+VAL = VAL_KEY.public_key().address()
+DEL = DEL_KEY.public_key().address()
+
+
+def fresh_app() -> App:
+    app = App()
+    app.init_chain(
+        {
+            "accounts": [
+                {"address": VAL.hex(), "balance": 10**9},
+                {"address": DEL.hex(), "balance": 10**9},
+            ],
+            "validators": [
+                {"address": VAL.hex(), "self_delegation": 100_000_000}
+            ],
+        }
+    )
+    return app
+
+
+def signed(key: PrivateKey, app: App, msgs, seq=0, fee=500):
+    addr = key.public_key().address()
+    acct = app.accounts.get(addr).account_number
+    tx = Tx(tuple(msgs), Fee(fee, 200_000), key.public_key().compressed(),
+            seq, acct)
+    return tx.signed(key, app.chain_id).marshal()
+
+
+def test_allocation_splits_tax_commission_and_rewards():
+    app = fresh_app()
+    # put exactly 1_000_000utia of "fees" in the collector, no mint noise
+    app.bank.mint(FEE_COLLECTOR, 1_000_000)
+    report = app.distribution.allocate_tokens(proposer=None, votes=None)
+    assert report["fees"] >= 1_000_000
+    fees = report["fees"]
+    # 2% community tax (+ any rounding dust)
+    assert report["community"] >= fees * COMMUNITY_TAX_PPM // 1_000_000
+    assert app.distribution.community_pool() == report["community"]
+    # the single validator got everything else: 10% commission default
+    allocated = fees - report["community"]
+    assert report["distributed"] == allocated
+    assert app.distribution.commission(VAL) == allocated * 100_000 // 1_000_000
+    # module account escrows the undistributed total
+    assert app.bank.balance(DISTRIBUTION_MODULE) == fees
+    assert app.bank.balance(FEE_COLLECTOR) == 0
+
+
+def test_proposer_bonus():
+    app = fresh_app()
+    app.bank.mint(FEE_COLLECTOR, 1_000_000)
+    report = app.distribution.allocate_tokens(
+        proposer=VAL, votes=[(VAL, True)]
+    )
+    # full signed power -> 1% base + 4% bonus = 5% of fees
+    assert report["proposer"] == report["fees"] * 50_000 // 1_000_000
+
+
+def test_delegator_rewards_accrue_and_withdraw():
+    app = fresh_app()
+    # delegator bonds half as much as the validator's self-delegation
+    app.begin_block(2, app.genesis_time_ns + 10**9)
+    res = app.deliver_tx(signed(DEL_KEY, app, [
+        MsgDelegate(DEL, VAL, 50_000_000)
+    ]))
+    assert res.code == 0, res.log
+    # inject fees and allocate (the collector also holds the 500utia tx fee)
+    app.bank.mint(FEE_COLLECTOR, 3_000_000)
+    fee_amt = app.bank.balance(FEE_COLLECTOR)
+    app.distribution.allocate_tokens(None, None)
+    pending = app.distribution.pending_rewards(DEL, VAL)
+    # delegator owns 1/3 of stake; rewards pool after 2% tax + 10% commission
+    to_delegators = (fee_amt - fee_amt * 2 // 100) * 90 // 100
+    assert abs(pending - to_delegators // 3) <= 2
+    # withdraw pays out and resets
+    bal_before = app.bank.balance(DEL)
+    res = app.deliver_tx(signed(DEL_KEY, app, [
+        MsgWithdrawDelegatorReward(DEL, VAL)
+    ], seq=1))
+    assert res.code == 0, res.log
+    paid = app.bank.balance(DEL) - bal_before + 500  # add back the tx fee
+    assert paid == pending
+    assert app.distribution.pending_rewards(DEL, VAL) == 0
+
+
+def test_stake_change_settles_before_accruing_at_new_rate():
+    """F1 invariant: rewards accrued at the old stake are settled when the
+    delegation changes; new rewards accrue on the new stake."""
+    app = fresh_app()
+    app.begin_block(2, app.genesis_time_ns + 10**9)
+    assert app.deliver_tx(signed(DEL_KEY, app, [
+        MsgDelegate(DEL, VAL, 100_000_000)  # now 50% of total stake
+    ])).code == 0
+    app.bank.mint(FEE_COLLECTOR, 1_000_000)
+    app.distribution.allocate_tokens(None, None)
+    first = app.distribution.pending_rewards(DEL, VAL)
+    assert first > 0
+    # delegating more auto-settles the accrued rewards to the delegator
+    bal_before = app.bank.balance(DEL)
+    assert app.deliver_tx(signed(DEL_KEY, app, [
+        MsgDelegate(DEL, VAL, 100_000_000)
+    ], seq=1)).code == 0
+    assert app.bank.balance(DEL) == bal_before - 100_000_000 - 500 + first
+    assert app.distribution.pending_rewards(DEL, VAL) == 0
+
+
+def test_withdraw_commission_and_address_redirect():
+    app = fresh_app()
+    app.bank.mint(FEE_COLLECTOR, 1_000_000)
+    app.distribution.allocate_tokens(None, None)
+    commission = app.distribution.commission(VAL)
+    assert commission > 0
+    app.begin_block(2, app.genesis_time_ns + 10**9)
+    # redirect withdrawals to a cold address
+    cold = b"\xcc" * 20
+    assert app.deliver_tx(signed(VAL_KEY, app, [
+        MsgSetWithdrawAddress(VAL, cold)
+    ])).code == 0
+    res = app.deliver_tx(signed(VAL_KEY, app, [
+        MsgWithdrawValidatorCommission(VAL)
+    ], seq=1))
+    assert res.code == 0, res.log
+    # commission accrued since (allocate runs in begin_block too) goes to cold
+    assert app.bank.balance(cold) >= commission
+    assert app.distribution.commission(VAL) == 0
+    # double-withdraw fails
+    res = app.deliver_tx(signed(VAL_KEY, app, [
+        MsgWithdrawValidatorCommission(VAL)
+    ], seq=2))
+    assert res.code == 2
+
+
+def test_fund_and_spend_community_pool():
+    app = fresh_app()
+    app.begin_block(2, app.genesis_time_ns + 10**9)
+    pool_before = app.distribution.community_pool()
+    assert app.deliver_tx(signed(DEL_KEY, app, [
+        MsgFundCommunityPool(DEL, 42_000)
+    ])).code == 0
+    assert app.distribution.community_pool() == pool_before + 42_000
+    # spend is keeper-level (gov-gated in the reference)
+    app.distribution.spend_community_pool(b"\xdd" * 20, 40_000)
+    assert app.bank.balance(b"\xdd" * 20) == 40_000
+    with pytest.raises(DistributionError):
+        app.distribution.spend_community_pool(b"\xdd" * 20, 10**12)
+
+
+def test_block_fees_flow_to_stakers_end_to_end():
+    """Fees paid by txs in block H are allocated at block H+1's begin."""
+    app = fresh_app()
+    app.begin_block(2, app.genesis_time_ns + 10**9)
+    res = app.deliver_tx(signed(DEL_KEY, app, [
+        MsgSend(DEL, b"\x07" * 20, 10)
+    ], fee=5000))
+    assert res.code == 0
+    assert app.bank.balance(FEE_COLLECTOR) >= 5000
+    com_before = app.distribution.commission(VAL)
+    app.begin_block(3, app.genesis_time_ns + 2 * 10**9, proposer=VAL,
+                    votes=[(VAL, True)])
+    assert app.bank.balance(FEE_COLLECTOR) == 0
+    assert app.distribution.commission(VAL) > com_before

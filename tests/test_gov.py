@@ -173,3 +173,72 @@ def test_non_validator_cannot_vote():
     )
     assert vote.code != 0
     assert "bonded" in vote.log
+
+
+def test_community_pool_spend_proposal():
+    """Distribution CommunityPoolSpendProposal through the gov flow: fund
+    the pool, pass a spend proposal, recipient gets paid from the pool."""
+    node, alice, valkey = _make_net()
+    signer = Signer(node, alice)
+    val_signer = Signer(node, valkey)
+    from celestia_tpu.state.tx import MsgFundCommunityPool
+
+    res = signer.submit_tx(
+        [MsgFundCommunityPool(signer.address, 5_000_000)]
+    )
+    assert res.code == 0, res.log
+    pool = node.app.distribution.community_pool()
+    assert pool >= 5_000_000
+    recipient = b"\x99" * 20
+    msg = MsgSubmitProposal(
+        proposer=signer.address,
+        title="grant",
+        description="pay the builder",
+        changes=(),
+        deposit=DEFAULT_MIN_DEPOSIT,
+        spend_to=recipient,
+        spend_amount=3_000_000,
+    )
+    res = signer.submit_tx([msg])
+    assert res.code == 0, res.log
+    node.produce_block()
+    prop = node.app.gov.proposals()[-1]
+    vote = val_signer.submit_tx(
+        [MsgVote(val_signer.address, prop.id, MsgVote.OPTION_YES)]
+    )
+    assert vote.code == 0, vote.log
+    node.produce_blocks(3)
+    prop = node.app.gov.proposal(prop.id)
+    assert prop.status == PROPOSAL_STATUS_PASSED, prop.result_log
+    assert node.app.bank.balance(recipient) == 3_000_000
+    # the pool paid the spend (it keeps accruing community tax each block,
+    # so compare against the pre-spend level, not exact equality)
+    assert node.app.distribution.community_pool() < pool
+
+
+def test_overdrawn_community_spend_fails_whole_proposal():
+    node, alice, valkey = _make_net()
+    signer = Signer(node, alice)
+    val_signer = Signer(node, valkey)
+    msg = MsgSubmitProposal(
+        proposer=signer.address,
+        title="overdraw",
+        description="spend more than the pool holds",
+        changes=(),
+        deposit=DEFAULT_MIN_DEPOSIT,
+        spend_to=b"\x98" * 20,
+        spend_amount=10**15,
+    )
+    res = signer.submit_tx([msg])
+    assert res.code == 0, res.log
+    node.produce_block()
+    prop = node.app.gov.proposals()[-1]
+    vote = val_signer.submit_tx(
+        [MsgVote(val_signer.address, prop.id, MsgVote.OPTION_YES)]
+    )
+    assert vote.code == 0, vote.log
+    node.produce_blocks(3)
+    prop = node.app.gov.proposal(prop.id)
+    assert prop.status == PROPOSAL_STATUS_FAILED
+    assert "community pool" in prop.result_log
+    assert node.app.bank.balance(b"\x98" * 20) == 0

@@ -1,0 +1,230 @@
+"""IBC extras: packet-forward middleware + ICS-27 interchain-accounts host.
+
+Reference wiring: PacketForwardKeeper (app/app.go:219) and ICAHostKeeper
+(app/app.go:203).  Three in-process chains exercise a multi-hop forward;
+an App-backed host executes controller transactions under the derived
+interchain account.
+"""
+
+import json
+
+import pytest
+
+from celestia_tpu.state.app import App
+from celestia_tpu.state.bank import BankKeeper
+from celestia_tpu.state.modules.ibc import (
+    ICA_HOST_PORT,
+    IBCStack,
+    Relayer,
+    forward_address,
+    interchain_account_address,
+)
+from celestia_tpu.state.modules.tokenfilter import (
+    FungibleTokenPacketData,
+    Packet,
+)
+from celestia_tpu.state.store import MultiStore
+from celestia_tpu.state.tx import MsgSend, marshal_msg
+
+
+def _mk_chain(name, filtered, accounts):
+    ms = MultiStore(["bank"])
+    bank = BankKeeper(ms.store("bank"))
+    for addr, amount, denom in accounts:
+        bank.mint_denom(addr, amount, denom)
+    return IBCStack(name=name, bank=bank, filtered=filtered)
+
+
+ALICE = b"\x11" * 20  # on chain A
+CAROL = b"\x13" * 20  # final receiver on chain C
+
+
+def test_packet_forward_two_hops():
+    """A -> B(hub) -> C: the hub's PFM receives into an intermediate
+    account and re-sends out the second channel; Carol on C ends with a
+    two-hop voucher and the hub keeps no residual balance."""
+    a = _mk_chain("osmosis", False, [(ALICE, 1_000_000, "uosmo")])
+    b = _mk_chain("hub", False, [])
+    c = _mk_chain("juno", False, [])
+    r_ab = Relayer(a, b, "channel-0", "channel-0")
+    # second hop: B's channel-1 <-> C's channel-0
+    r_bc = Relayer(b, c, "channel-1", "channel-0")
+
+    memo = json.dumps({"forward": {"receiver": CAROL.hex(), "channel": "channel-1"}})
+    packet, seq = a.module.send_transfer(
+        ALICE, "ignored-by-pfm", 250_000, "uosmo", "channel-0"
+    )
+    # rewrite packet data to carry the forward memo (send_transfer has no
+    # memo param on the src chain; the memo is consumed by the HUB)
+    data = FungibleTokenPacketData.from_json(packet.data)
+    packet = Packet(
+        packet.source_port, packet.source_channel,
+        packet.dest_port, packet.dest_channel,
+        FungibleTokenPacketData(
+            data.denom, data.amount, data.sender, data.receiver, memo
+        ).to_json(),
+    )
+    ack = r_ab.relay(a, packet, seq)
+    assert ack.success, ack.error
+    # the hub forwarded: its channel-1 log has the onward packet
+    onward = [p for p, _ in b.channels.sent if p.source_channel == "channel-1"]
+    assert len(onward) == 1
+    onward_packet, onward_seq = b.channels.sent[-1]
+    ack2 = r_bc.relay(b, onward_packet, onward_seq)
+    assert ack2.success, ack2.error
+    # Carol holds the two-hop voucher on C
+    two_hop = "transfer/channel-0/transfer/channel-0/uosmo"
+    assert c.bank.balance_of(CAROL, two_hop) == 250_000
+    # the hub's intermediate account kept nothing (escrow holds the hop)
+    inter = forward_address("channel-1", CAROL.hex())
+    assert b.bank.balance_of(inter, "transfer/channel-0/uosmo") == 0
+
+
+def test_forward_to_unknown_channel_error_acks():
+    a = _mk_chain("osmosis", False, [(ALICE, 100_000, "uosmo")])
+    b = _mk_chain("hub", False, [])
+    r_ab = Relayer(a, b, "channel-0", "channel-0")
+    memo = json.dumps({"forward": {"receiver": CAROL.hex(), "channel": "channel-9"}})
+    packet, seq = a.module.send_transfer(
+        ALICE, "x", 100_000, "uosmo", "channel-0"
+    )
+    data = FungibleTokenPacketData.from_json(packet.data)
+    packet = Packet(
+        packet.source_port, packet.source_channel,
+        packet.dest_port, packet.dest_channel,
+        FungibleTokenPacketData(
+            data.denom, data.amount, data.sender, data.receiver, memo
+        ).to_json(),
+    )
+    ack = r_ab.relay(a, packet, seq)
+    assert not ack.success and "forward failed" in ack.error
+    # the error ack refunded Alice on the source chain
+    assert a.bank.balance_of(ALICE, "uosmo") == 100_000
+
+
+def test_forbidden_token_never_forwards_on_filtered_chain():
+    """The token filter sits INSIDE the forward middleware: a foreign
+    token bound for a forward hop is rejected before any forwarding."""
+    a = _mk_chain("osmosis", False, [(ALICE, 100_000, "uosmo")])
+    celestia = _mk_chain("celestia", True, [])
+    r = Relayer(a, celestia, "channel-0", "channel-0")
+    celestia.channels.open_channel("channel-1", "channel-0")
+    memo = json.dumps({"forward": {"receiver": CAROL.hex(), "channel": "channel-1"}})
+    packet, seq = a.module.send_transfer(ALICE, "x", 50_000, "uosmo", "channel-0")
+    data = FungibleTokenPacketData.from_json(packet.data)
+    packet = Packet(
+        packet.source_port, packet.source_channel,
+        packet.dest_port, packet.dest_channel,
+        FungibleTokenPacketData(
+            data.denom, data.amount, data.sender, data.receiver, memo
+        ).to_json(),
+    )
+    ack = r.relay(a, packet, seq)
+    assert not ack.success
+    assert "not accepted" in ack.error
+    assert a.bank.balance_of(ALICE, "uosmo") == 100_000  # refunded
+
+
+# --- ICS-27 host ------------------------------------------------------------
+
+
+def _ica_packet(owner: str, connection: str, msgs) -> Packet:
+    return Packet(
+        source_port="icacontroller",
+        source_channel="channel-0",
+        dest_port=ICA_HOST_PORT,
+        dest_channel="channel-7",
+        data=json.dumps(
+            {
+                "type": "ica_tx",
+                "owner": owner,
+                "connection": connection,
+                "msgs": [marshal_msg(m).hex() for m in msgs],
+            }
+        ).encode(),
+    )
+
+
+def test_ica_host_executes_controller_tx():
+    app = App()
+    ica = interchain_account_address("connection-0", "osmo1owner")
+    app.init_chain({"accounts": [{"address": ica.hex(), "balance": 500_000}]})
+    dest = b"\x44" * 20
+    packet = _ica_packet(
+        "osmo1owner", "connection-0", [MsgSend(ica, dest, 200_000)]
+    )
+    ack = app.ibc.on_recv_packet(packet)
+    assert ack.success, ack.error
+    assert app.bank.balance(dest) == 200_000
+    assert app.bank.balance(ica) == 300_000
+
+
+def test_ica_host_rejects_foreign_signer():
+    """A controller can only act as ITS interchain account."""
+    app = App()
+    victim = b"\x55" * 20
+    app.init_chain({"accounts": [{"address": victim.hex(), "balance": 500_000}]})
+    packet = _ica_packet(
+        "osmo1owner", "connection-0", [MsgSend(victim, b"\x56" * 20, 1)]
+    )
+    ack = app.ibc.on_recv_packet(packet)
+    assert not ack.success
+    assert "not the interchain account" in ack.error
+    assert app.bank.balance(victim) == 500_000
+
+
+def test_ica_host_atomic_rollback():
+    """Two msgs, second fails: the first must not leave partial writes."""
+    app = App()
+    ica = interchain_account_address("connection-0", "osmo1owner")
+    app.init_chain({"accounts": [{"address": ica.hex(), "balance": 100}]})
+    dest = b"\x57" * 20
+    packet = _ica_packet(
+        "osmo1owner", "connection-0",
+        [MsgSend(ica, dest, 50), MsgSend(ica, dest, 10**9)],
+    )
+    ack = app.ibc.on_recv_packet(packet)
+    assert not ack.success
+    assert app.bank.balance(dest) == 0
+    assert app.bank.balance(ica) == 100
+
+
+def test_ica_host_allowlist():
+    from celestia_tpu.state.modules.ibc import ICAHostModule
+    from celestia_tpu.state.tx import MsgPayForBlobs
+
+    app = App()
+    ica = interchain_account_address("connection-0", "osmo1owner")
+    app.init_chain({"accounts": [{"address": ica.hex(), "balance": 500_000}]})
+    app.ibc.ica_host = ICAHostModule(app, allow_msgs=[MsgPayForBlobs.TYPE])
+    packet = _ica_packet(
+        "osmo1owner", "connection-0", [MsgSend(ica, b"\x58" * 20, 1)]
+    )
+    ack = app.ibc.on_recv_packet(packet)
+    assert not ack.success and "not allowed" in ack.error
+
+
+def test_failed_forward_conserves_supply():
+    """Review finding: a failed onward hop must remove the hop-1 credit
+    before error-acking, or the refund doubles the supply."""
+    a = _mk_chain("osmosis", False, [(ALICE, 100_000, "uosmo")])
+    b = _mk_chain("hub", False, [])
+    r_ab = Relayer(a, b, "channel-0", "channel-0")
+    memo = json.dumps({"forward": {"receiver": CAROL.hex(), "channel": "channel-9"}})
+    packet, seq = a.module.send_transfer(ALICE, "x", 100_000, "uosmo", "channel-0")
+    data = FungibleTokenPacketData.from_json(packet.data)
+    packet = Packet(
+        packet.source_port, packet.source_channel,
+        packet.dest_port, packet.dest_channel,
+        FungibleTokenPacketData(
+            data.denom, data.amount, data.sender, data.receiver, memo
+        ).to_json(),
+    )
+    ack = r_ab.relay(a, packet, seq)
+    assert not ack.success
+    # sender refunded on A...
+    assert a.bank.balance_of(ALICE, "uosmo") == 100_000
+    # ...and the hub holds NO residual voucher anywhere (hop-1 reversed)
+    inter = forward_address("channel-9", CAROL.hex())
+    voucher = "transfer/channel-0/uosmo"
+    assert b.bank.balance_of(inter, voucher) == 0

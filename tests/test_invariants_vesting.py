@@ -1,0 +1,194 @@
+"""x/crisis invariants + auth/vesting accounts.
+
+Mirrors the reference's CrisisKeeper registration (app/app.go:312-315) and
+the SDK vesting account types its auth module ships (locked balances,
+delegate-while-locked, fee payment from vested coins).
+"""
+
+import pytest
+
+from celestia_tpu.state.app import App
+from celestia_tpu.state.bank import BONDED_POOL
+from celestia_tpu.state.invariants import (
+    InvariantBroken,
+    assert_invariants,
+)
+from celestia_tpu.state.tx import (
+    Fee,
+    MsgCreateVestingAccount,
+    MsgDelegate,
+    MsgSend,
+    MsgVerifyInvariant,
+    Tx,
+)
+from celestia_tpu.utils.secp256k1 import PrivateKey
+
+FUNDER_KEY = PrivateKey.from_seed(b"vest-funder")
+BENEF_KEY = PrivateKey.from_seed(b"vest-benef")
+FUNDER = FUNDER_KEY.public_key().address()
+BENEF = BENEF_KEY.public_key().address()
+
+
+def fresh_app() -> App:
+    app = App()
+    app.init_chain(
+        {
+            "accounts": [
+                {"address": FUNDER.hex(), "balance": 10**9},
+                {"address": BENEF.hex(), "balance": 10_000},
+            ],
+            "validators": [
+                {"address": FUNDER.hex(), "self_delegation": 100_000_000}
+            ],
+        }
+    )
+    app.begin_block(2, app.genesis_time_ns + 10**9)
+    return app
+
+
+def signed(key: PrivateKey, app: App, msgs, seq=0):
+    addr = key.public_key().address()
+    acct = app.accounts.get(addr).account_number
+    tx = Tx(tuple(msgs), Fee(1500, 500_000), key.public_key().compressed(),
+            seq, acct)
+    return tx.signed(key, app.chain_id).marshal()
+
+
+# --- invariants -------------------------------------------------------------
+
+
+def test_invariants_hold_on_live_app():
+    app = fresh_app()
+    results = assert_invariants(app)
+    assert set(results) == {
+        "bank/total-supply", "staking/bonded-pool",
+        "distribution/solvency", "gov/deposits",
+    }
+
+
+def test_invariant_detects_supply_corruption():
+    app = fresh_app()
+    # corrupt: credit a balance without minting supply
+    app.bank._set_balance(b"\x66" * 20, 12345)
+    with pytest.raises(InvariantBroken, match="total-supply"):
+        assert_invariants(app)
+
+
+def test_invariant_detects_bonded_pool_theft():
+    app = fresh_app()
+    app.bank._set_balance(
+        BONDED_POOL, app.bank.balance(BONDED_POOL) - 1
+    )
+    app.bank._set_balance(b"\x67" * 20, 1)  # keep supply consistent
+    with pytest.raises(InvariantBroken, match="bonded-pool"):
+        assert_invariants(app)
+
+
+def test_msg_verify_invariant_on_chain():
+    app = fresh_app()
+    res = app.deliver_tx(signed(BENEF_KEY, app, [
+        MsgVerifyInvariant(BENEF)
+    ]))
+    assert res.code == 0, res.log
+    assert res.events[0]["results"]["bank/total-supply"] == "ok"
+    # a named invariant costs less gas than all four
+    res2 = app.deliver_tx(signed(BENEF_KEY, app, [
+        MsgVerifyInvariant(BENEF, "bank/total-supply")
+    ], seq=1))
+    assert res2.code == 0
+    assert res2.gas_used < res.gas_used
+
+
+# --- vesting ----------------------------------------------------------------
+
+
+def test_continuous_vesting_unlocks_linearly():
+    app = fresh_app()
+    t0 = app.block_time_ns
+    end = t0 + 100 * 10**9
+    res = app.deliver_tx(signed(FUNDER_KEY, app, [
+        MsgCreateVestingAccount(FUNDER, b"\x70" * 20, 1_000_000, end)
+    ]))
+    assert res.code == 0, res.log
+    addr = b"\x70" * 20
+    assert app.bank.balance(addr) == 1_000_000
+    assert app.bank.locked(addr) == 1_000_000  # t == start
+    # halfway: half unlocked
+    app.begin_block(3, t0 + 50 * 10**9)
+    assert app.bank.locked(addr) == 500_000
+    assert app.bank.spendable(addr) == 500_000
+    # after end: fully vested, schedule pruned
+    app.begin_block(4, end + 1)
+    assert app.bank.locked(addr) == 0
+    assert app.bank.vesting_schedule(addr) is None
+
+
+def test_vesting_blocks_overspend_but_allows_vested():
+    app = fresh_app()
+    t0 = app.block_time_ns
+    vest_key = PrivateKey.from_seed(b"vest-target")
+    vest_addr = vest_key.public_key().address()
+    end = t0 + 100 * 10**9
+    assert app.deliver_tx(signed(FUNDER_KEY, app, [
+        MsgCreateVestingAccount(FUNDER, vest_addr, 1_000_000, end)
+    ])).code == 0
+    app.begin_block(3, t0 + 50 * 10**9)  # 500k vested
+    # spending more than the vested portion fails atomically
+    res = app.deliver_tx(signed(vest_key, app, [
+        MsgSend(vest_addr, b"\x71" * 20, 900_000)
+    ]))
+    assert res.code == 2 and "vesting" in res.log
+    # spending within the vested portion works (fee also comes from vested)
+    res = app.deliver_tx(signed(vest_key, app, [
+        MsgSend(vest_addr, b"\x71" * 20, 400_000)
+    ], seq=1))
+    assert res.code == 0, res.log
+    assert app.bank.balance(b"\x71" * 20) == 400_000
+
+
+def test_delayed_vesting_locks_everything_until_end():
+    app = fresh_app()
+    t0 = app.block_time_ns
+    end = t0 + 100 * 10**9
+    addr = b"\x72" * 20
+    assert app.deliver_tx(signed(FUNDER_KEY, app, [
+        MsgCreateVestingAccount(FUNDER, addr, 1_000_000, end, delayed=True)
+    ])).code == 0
+    app.begin_block(3, t0 + 99 * 10**9)
+    assert app.bank.locked(addr) == 1_000_000  # no linear release
+    app.begin_block(4, end + 1)
+    assert app.bank.locked(addr) == 0
+
+
+def test_vesting_account_can_delegate_locked_coins():
+    """SDK parity: locked coins ARE delegable (sends to the bonded pool
+    bypass the vesting lock)."""
+    app = fresh_app()
+    t0 = app.block_time_ns
+    vest_key = PrivateKey.from_seed(b"vest-delegator")
+    vest_addr = vest_key.public_key().address()
+    assert app.deliver_tx(signed(FUNDER_KEY, app, [
+        MsgCreateVestingAccount(
+            FUNDER, vest_addr, 10_000_000, t0 + 10**12, delayed=True
+        ),
+        # liquid top-up: fees must come from SPENDABLE balance
+        MsgSend(FUNDER, vest_addr, 10_000),
+    ])).code == 0
+    res = app.deliver_tx(signed(vest_key, app, [
+        MsgDelegate(vest_addr, FUNDER, 9_000_000)
+    ]))
+    assert res.code == 0, res.log
+    assert app.staking.delegation(vest_addr, FUNDER) == 9_000_000
+
+
+def test_duplicate_vesting_schedule_rejected():
+    app = fresh_app()
+    addr = b"\x73" * 20
+    end = app.block_time_ns + 10**12
+    assert app.deliver_tx(signed(FUNDER_KEY, app, [
+        MsgCreateVestingAccount(FUNDER, addr, 1000, end)
+    ])).code == 0
+    res = app.deliver_tx(signed(FUNDER_KEY, app, [
+        MsgCreateVestingAccount(FUNDER, addr, 1000, end)
+    ], seq=1))
+    assert res.code == 2 and "already has a vesting schedule" in res.log

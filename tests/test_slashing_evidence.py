@@ -1,0 +1,254 @@
+"""x/slashing + x/evidence: downtime jailing, unjail, equivocation.
+
+Mirrors the reference's SlashingKeeper/EvidenceKeeper wiring
+(app/app.go:192,200,307-332): liveness window -> downtime slash + jail;
+double-sign evidence -> hard slash + tombstone.
+"""
+
+import pytest
+
+from celestia_tpu.state.app import App
+from celestia_tpu.state.modules.evidence import (
+    Equivocation,
+    EvidenceError,
+    MAX_AGE_NUM_BLOCKS,
+)
+from celestia_tpu.state.modules.slashing import (
+    DOWNTIME_JAIL_DURATION_NS,
+    SLASH_FRACTION_DOUBLE_SIGN_PPM,
+    SLASH_FRACTION_DOWNTIME_PPM,
+)
+from celestia_tpu.state.tx import (
+    Fee,
+    MsgSubmitEvidence,
+    MsgUnjail,
+    Tx,
+)
+from celestia_tpu.utils.secp256k1 import PrivateKey
+
+VAL_KEY = PrivateKey.from_seed(b"slash-val")
+OTHER_KEY = PrivateKey.from_seed(b"slash-other")
+VAL = VAL_KEY.public_key().address()
+OTHER = OTHER_KEY.public_key().address()
+
+SELF_DELEGATION = 100_000_000
+
+
+def fresh_app(window: int = 10) -> App:
+    app = App()
+    app.init_chain(
+        {
+            "accounts": [
+                {"address": VAL.hex(), "balance": 10**9},
+                {"address": OTHER.hex(), "balance": 10**9},
+            ],
+            "validators": [
+                {"address": VAL.hex(), "self_delegation": SELF_DELEGATION},
+                {"address": OTHER.hex(), "self_delegation": SELF_DELEGATION},
+            ],
+        }
+    )
+    app.slashing.window = window
+    return app
+
+
+def signed(key: PrivateKey, app: App, msgs, seq=0):
+    addr = key.public_key().address()
+    acct = app.accounts.get(addr).account_number
+    tx = Tx(tuple(msgs), Fee(500, 200_000), key.public_key().compressed(),
+            seq, acct)
+    return tx.signed(key, app.chain_id).marshal()
+
+
+def run_blocks(app: App, n: int, val_signs: bool, start: int = 2):
+    t0 = app.genesis_time_ns
+    for h in range(start, start + n):
+        app.begin_block(
+            h, t0 + h * 10**9,
+            votes=[(VAL, val_signs), (OTHER, True)],
+        )
+    return start + n
+
+
+def test_downtime_slash_and_jail():
+    app = fresh_app(window=10)
+    # sign through one full window, then go dark: >50% of 10 missed -> jail
+    h = run_blocks(app, 10, val_signs=True)
+    tokens_before = app.staking.validator(VAL).tokens
+    run_blocks(app, 7, val_signs=False, start=h)
+    v = app.staking.validator(VAL)
+    assert v.jailed
+    assert v.jailed_until_ns > 0
+    assert v.tokens == tokens_before - tokens_before * SLASH_FRACTION_DOWNTIME_PPM // 1_000_000
+    # a jailed validator contributes no power
+    assert all(b.operator != VAL for b in app.staking.bonded_validators())
+    # supply shrank by the burned stake
+    assert app.bank.supply() < 2 * 10**9 + 2 * SELF_DELEGATION
+
+
+def test_unjail_after_duration():
+    app = fresh_app(window=10)
+    h = run_blocks(app, 10, val_signs=True)
+    run_blocks(app, 7, val_signs=False, start=h)
+    assert app.staking.validator(VAL).jailed
+    until = app.staking.validator(VAL).jailed_until_ns
+    # too early -> msg fails
+    app.begin_block(100, until - 10**9)
+    res = app.deliver_tx(signed(VAL_KEY, app, [MsgUnjail(VAL)]))
+    assert res.code == 2 and "jailed until" in res.log
+    # after the duration -> back in the set
+    app.begin_block(101, until + 1)
+    res = app.deliver_tx(signed(VAL_KEY, app, [MsgUnjail(VAL)], seq=1))
+    assert res.code == 0, res.log
+    assert not app.staking.validator(VAL).jailed
+    assert any(b.operator == VAL for b in app.staking.bonded_validators())
+
+
+def test_signing_restarts_clean_after_jail():
+    app = fresh_app(window=10)
+    h = run_blocks(app, 10, val_signs=True)
+    run_blocks(app, 7, val_signs=False, start=h)
+    info = app.slashing.signing_info(VAL)
+    assert info.missed_blocks == 0  # window reset on jail
+
+
+def _double_sign_votes(app, key, height):
+    """Craft a real double-sign: two conflicting votes signed by `key`."""
+    from celestia_tpu.state.modules.evidence import vote_sign_bytes
+
+    bh_a, bh_b = b"\xaa" * 32, b"\xbb" * 32
+    sig_a = key.sign(vote_sign_bytes(app.chain_id, height, bh_a))
+    sig_b = key.sign(vote_sign_bytes(app.chain_id, height, bh_b))
+    return bh_a, sig_a, bh_b, sig_b
+
+
+def test_equivocation_tombstones():
+    app = fresh_app()
+    app.begin_block(5, app.genesis_time_ns + 5 * 10**9)
+    # bind the validator's pubkey (evidence verifies against it)
+    from celestia_tpu.state.tx import MsgSend
+
+    assert app.deliver_tx(signed(VAL_KEY, app, [
+        MsgSend(VAL, OTHER, 1)
+    ])).code == 0
+    tokens_before = app.staking.validator(VAL).tokens
+    bh_a, sig_a, bh_b, sig_b = _double_sign_votes(app, VAL_KEY, 4)
+    res = app.deliver_tx(signed(OTHER_KEY, app, [
+        MsgSubmitEvidence(
+            OTHER, VAL, 4, app.genesis_time_ns + 4 * 10**9,
+            bh_a, sig_a, bh_b, sig_b,
+        )
+    ]))
+    assert res.code == 0, res.log
+    v = app.staking.validator(VAL)
+    assert v.jailed and v.tombstoned
+    assert v.tokens == tokens_before - tokens_before * SLASH_FRACTION_DOUBLE_SIGN_PPM // 1_000_000
+    # tombstoned validators can never unjail
+    app.begin_block(6, app.genesis_time_ns + 10**12)
+    res = app.deliver_tx(signed(VAL_KEY, app, [MsgUnjail(VAL)], seq=1))
+    assert res.code == 2 and "tombstoned" in res.log
+
+
+def test_fabricated_evidence_cannot_slash():
+    """Evidence without valid conflicting signatures must NOT slash: the
+    msg path is permissionless, so unproven evidence = free validator
+    ejection (review finding)."""
+    app = fresh_app()
+    app.begin_block(5, app.genesis_time_ns + 5 * 10**9)
+    from celestia_tpu.state.tx import MsgSend
+
+    assert app.deliver_tx(signed(VAL_KEY, app, [
+        MsgSend(VAL, OTHER, 1)
+    ])).code == 0
+    tokens_before = app.staking.validator(VAL).tokens
+    # no signatures at all
+    res = app.deliver_tx(signed(OTHER_KEY, app, [
+        MsgSubmitEvidence(OTHER, VAL, 4, app.genesis_time_ns + 4 * 10**9)
+    ]))
+    assert res.code == 2
+    # signatures by the WRONG key (the submitter forges votes)
+    bh_a, sig_a, bh_b, sig_b = _double_sign_votes(app, OTHER_KEY, 4)
+    res = app.deliver_tx(signed(OTHER_KEY, app, [
+        MsgSubmitEvidence(
+            OTHER, VAL, 4, app.genesis_time_ns + 4 * 10**9,
+            bh_a, sig_a, bh_b, sig_b,
+        )
+    ], seq=1))
+    assert res.code == 2 and "does not verify" in res.log
+    # two votes for the SAME block = no conflict
+    from celestia_tpu.state.modules.evidence import vote_sign_bytes
+
+    bh = b"\xcc" * 32
+    sig = VAL_KEY.sign(vote_sign_bytes(app.chain_id, 4, bh))
+    res = app.deliver_tx(signed(OTHER_KEY, app, [
+        MsgSubmitEvidence(
+            OTHER, VAL, 4, app.genesis_time_ns + 4 * 10**9, bh, sig, bh, sig
+        )
+    ], seq=2))
+    assert res.code == 2 and "no conflict" in res.log
+    v = app.staking.validator(VAL)
+    assert not v.jailed and not v.tombstoned
+    assert v.tokens == tokens_before
+
+
+def test_slash_cuts_delegations_proportionally():
+    """Review finding: a slash must reduce delegation records too, or a
+    post-slash undelegate withdraws pre-slash amounts and corrupts the
+    bonded pool."""
+    from celestia_tpu.state.invariants import assert_invariants
+    from celestia_tpu.state.tx import MsgDelegate, MsgUndelegate
+
+    app = fresh_app(window=10)
+    app.begin_block(2, app.genesis_time_ns + 10**9)
+    # OTHER delegates to VAL on top of VAL's self-delegation
+    assert app.deliver_tx(signed(OTHER_KEY, app, [
+        MsgDelegate(OTHER, VAL, 50_000_000)
+    ])).code == 0
+    slashed = app.staking.slash(VAL, 100_000)  # 10%
+    assert slashed > 0
+    # each delegation cut by 10%
+    assert app.staking.delegation(OTHER, VAL) == 45_000_000
+    assert app.staking.delegation(VAL, VAL) == SELF_DELEGATION * 9 // 10
+    # delegations still sum to validator tokens; pool still 1:1 backed
+    v = app.staking.validator(VAL)
+    assert v.tokens == app.staking.delegation(OTHER, VAL) + app.staking.delegation(VAL, VAL)
+    assert_invariants(app)
+    # a full undelegate after the slash withdraws the REDUCED amount only
+    res = app.deliver_tx(signed(OTHER_KEY, app, [
+        MsgUndelegate(OTHER, VAL, 45_000_000)
+    ], seq=1))
+    assert res.code == 0, res.log
+    assert app.staking.validator(VAL).tokens == SELF_DELEGATION * 9 // 10
+    assert_invariants(app)
+
+
+def test_evidence_replay_and_age_rejected():
+    app = fresh_app()
+    app.begin_block(5, app.genesis_time_ns + 5 * 10**9)
+    ev = Equivocation(VAL, 4, app.genesis_time_ns + 4 * 10**9)
+    app.evidence.submit(ev, 5, app.genesis_time_ns + 5 * 10**9)
+    with pytest.raises(EvidenceError, match="already submitted"):
+        app.evidence.submit(ev, 5, app.genesis_time_ns + 5 * 10**9)
+    # stale evidence ignored
+    old = Equivocation(OTHER, 1, 0)
+    with pytest.raises(EvidenceError, match="too old"):
+        app.evidence.submit(
+            old, MAX_AGE_NUM_BLOCKS + 10, app.genesis_time_ns
+        )
+    # future-height evidence rejected
+    with pytest.raises(EvidenceError, match="outside"):
+        app.evidence.submit(Equivocation(OTHER, 99, 0), 5, 0)
+
+
+def test_intermittent_signing_does_not_jail():
+    """Missing some blocks but staying >= 50% signed keeps the validator
+    bonded (sliding-window semantics, not a consecutive-miss counter)."""
+    app = fresh_app(window=10)
+    h = run_blocks(app, 10, val_signs=True)
+    t0 = app.genesis_time_ns
+    for i in range(30):
+        app.begin_block(
+            h + i, t0 + (h + i) * 10**9,
+            votes=[(VAL, i % 2 == 0), (OTHER, True)],  # sign every other block
+        )
+    assert not app.staking.validator(VAL).jailed

@@ -75,11 +75,19 @@ def test_nonce_mismatch_recovery(node_and_signer):
 
 
 def test_fees_collected(node_and_signer):
+    """Fees land in the collector at delivery, then x/distribution drains
+    the collector at the NEXT block's begin (so the balance is transient)."""
+    from celestia_tpu.state.modules.distribution import DISTRIBUTION_MODULE
+
     node, signer = node_and_signer
-    fees_before = node.app.bank.balance(FEE_COLLECTOR)
     res = signer.submit_tx([MsgSend(signer.address, b"\x05" * 20, 1)])
     assert res.code == 0
-    assert node.app.bank.balance(FEE_COLLECTOR) > fees_before
+    # the tx's block holds its fees in the collector until the next begin
+    assert node.app.bank.balance(FEE_COLLECTOR) > 0
+    dist_before = node.app.bank.balance(DISTRIBUTION_MODULE)
+    node.produce_block()
+    assert node.app.bank.balance(FEE_COLLECTOR) == 0
+    assert node.app.bank.balance(DISTRIBUTION_MODULE) > dist_before
 
 
 def test_unfunded_account_rejected(node_and_signer):

@@ -29,7 +29,6 @@ from celestia_tpu.appconsts import (
     round_down_power_of_two,
 )
 from celestia_tpu.da.blob import Blob
-from celestia_tpu.da.shares import shares_to_array, split_blob_into_shares
 from celestia_tpu.da.square import subtree_width
 from celestia_tpu.ops import nmt as nmt_ops
 from celestia_tpu.utils import native
@@ -67,8 +66,9 @@ def create_commitment(
     blob: Blob, subtree_root_threshold: int = DEFAULT_SUBTREE_ROOT_THRESHOLD
 ) -> bytes:
     """32-byte share commitment of a blob."""
-    shares = split_blob_into_shares(blob.namespace, blob.data, blob.share_version)
-    arr = shares_to_array(shares)  # (n, 512)
+    from celestia_tpu.da.shares import blob_shares_array
+
+    arr = blob_shares_array(blob.namespace, blob.data, blob.share_version)
     n = arr.shape[0]
     width = subtree_width(n, subtree_root_threshold)
     sizes = merkle_mountain_range_sizes(n, width)
@@ -79,6 +79,9 @@ def create_commitment(
     leaves = np.ascontiguousarray(
         np.concatenate([ns, arr], axis=1)
     )  # (n, 541)
+    if native.available():
+        # one native call per blob (subtree roots + RFC-6962 fold inside)
+        return native.create_commitment(leaves, sizes)
     roots: List[bytes] = []
     offset = 0
     for s in sizes:

@@ -155,6 +155,48 @@ def sparse_shares_needed(blob_len: int) -> int:
     return 1 + -(-rem // CONTINUATION_SPARSE_SHARE_CONTENT_SIZE)
 
 
+def blob_shares_array(
+    namespace: Namespace, data: bytes, share_version: int = DEFAULT_SHARE_VERSION
+) -> "np.ndarray":
+    """Vectorized split_blob_into_shares: uint8[n, 512] directly, no Share
+    objects.  Bit-identical to the per-share path (asserted in tests); used
+    where only the tensor is needed (commitment recompute runs once per
+    blob per proposal — the Python share loop dominated that host cost)."""
+    import numpy as np
+
+    if share_version not in SUPPORTED_SHARE_VERSIONS:
+        raise ValueError(f"unsupported share version {share_version}")
+    if len(data) == 0:
+        raise ValueError("blob data must be non-empty")
+    n = sparse_shares_needed(len(data))
+    arr = np.zeros((n, SHARE_SIZE), dtype=np.uint8)
+    ns = np.frombuffer(namespace.raw, dtype=np.uint8)
+    arr[:, :NAMESPACE_SIZE] = ns
+    info_off = NAMESPACE_SIZE
+    arr[0, info_off] = _info_byte(share_version, True)
+    if n > 1:
+        arr[1:, info_off] = _info_byte(share_version, False)
+    seq_off = info_off + SHARE_INFO_BYTES
+    arr[0, seq_off : seq_off + SEQUENCE_LEN_BYTES] = np.frombuffer(
+        len(data).to_bytes(SEQUENCE_LEN_BYTES, "big"), dtype=np.uint8
+    )
+    first_off = seq_off + SEQUENCE_LEN_BYTES
+    buf = np.frombuffer(data, dtype=np.uint8)
+    first_n = min(len(data), FIRST_SPARSE_SHARE_CONTENT_SIZE)
+    arr[0, first_off : first_off + first_n] = buf[:first_n]
+    rest = buf[first_n:]
+    if rest.size:
+        cont_off = info_off + SHARE_INFO_BYTES
+        padded = np.zeros(
+            (n - 1) * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE, dtype=np.uint8
+        )
+        padded[: rest.size] = rest
+        arr[1:, cont_off : cont_off + CONTINUATION_SPARSE_SHARE_CONTENT_SIZE] = (
+            padded.reshape(n - 1, CONTINUATION_SPARSE_SHARE_CONTENT_SIZE)
+        )
+    return arr
+
+
 def parse_sparse_shares(shares: Sequence[Share]) -> List[Tuple[Namespace, bytes]]:
     """Reassemble (namespace, blob-bytes) sequences from sparse shares.
 

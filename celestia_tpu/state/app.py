@@ -251,8 +251,11 @@ class App:
                 raw_inner = btx.tx
             else:
                 tx = unmarshal_tx(raw)
-                if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs):
+                from celestia_tpu.state.ante import flat_msgs
+
+                if any(isinstance(m, MsgPayForBlobs) for m in flat_msgs(tx)):
                     # PFB without blobs is never admissible (check_tx.go:30)
+                    # — including authz-wrapped PFBs
                     return TxResult(1, "MsgPayForBlobs transaction missing blobs", 0, 0)
                 raw_inner = raw
             ctx = AnteContext(
@@ -301,7 +304,9 @@ class App:
                     raw_inner = btx.tx
                 else:
                     tx = unmarshal_tx(raw)
-                    if any(isinstance(m, MsgPayForBlobs) for m in tx.msgs):
+                    from celestia_tpu.state.ante import flat_msgs
+
+                    if any(isinstance(m, MsgPayForBlobs) for m in flat_msgs(tx)):
                         raise AnteError("PFB without blobs")
                     raw_inner = raw
                 decoded.append((raw, tx, raw_inner, None))

@@ -136,7 +136,11 @@ class EvidenceKeeper:
             )
         age_blocks = current_height - ev.height
         age_ns = now_ns - ev.time_ns
-        if age_blocks > MAX_AGE_NUM_BLOCKS or age_ns > MAX_AGE_DURATION_NS:
+        # expire only when BOTH bounds are exceeded (CometBFT's rule).
+        # ev.time_ns is submitter-supplied and not signature-covered, so it
+        # must never be the SOLE gate in either direction: the height bound
+        # (consensus-verified) always has the final say.
+        if age_blocks > MAX_AGE_NUM_BLOCKS and age_ns > MAX_AGE_DURATION_NS:
             raise EvidenceError(
                 f"evidence too old: {age_blocks} blocks / {age_ns}ns past max age"
             )

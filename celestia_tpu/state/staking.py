@@ -184,8 +184,16 @@ class StakingKeeper:
             cut = delegation * fraction_ppm // 1_000_000
             if cut == 0:
                 continue
+            delegator = key[len(_DEL_PREFIX) : len(_DEL_PREFIX) + 20]
+            # settle rewards at the pre-slash stake and re-anchor after —
+            # a stale F1 reference point would over-pay rewards on stake
+            # that no longer exists
+            for hook in self.hooks_before_delegation_modified:
+                hook(delegator, operator)
             self.store.set(key, (delegation - cut).to_bytes(16, "big"))
             burn += cut
+            for hook in self.hooks_after_delegation_modified:
+                hook(delegator, operator)
         if burn == 0:
             return 0
         v.tokens -= burn

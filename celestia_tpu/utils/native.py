@@ -58,6 +58,10 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.rs_extend_square.argtypes = [u8p, u8p, u8p, ctypes.c_int, ctypes.c_int]
     lib.sha256_batch.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
     lib.nmt_root.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
+    lib.create_commitment.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int, u8p,
+    ]
     lib.eds_nmt_roots.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
     lib.gf_matmul_axes.argtypes = [
         u8p, u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -159,6 +163,27 @@ def nmt_root(leaves: np.ndarray) -> np.ndarray:
     out = np.zeros(90, dtype=np.uint8)
     lib.nmt_root(_ptr(leaves), n, leaf_len, _ptr(out))
     return out
+
+
+def create_commitment(leaves: np.ndarray, sizes) -> bytes:
+    """Blob share commitment in ONE native call: NMT roots of the
+    mountain-range subtrees + the RFC-6962 root over them.
+
+    leaves: uint8[n, leaf_len] ns-prefixed shares; sizes: mountain widths
+    summing to n.  Replaces ~one ctypes crossing per subtree."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    leaves = np.ascontiguousarray(leaves, dtype=np.uint8)
+    n, leaf_len = leaves.shape
+    sizes_arr = np.ascontiguousarray(sizes, dtype=np.int32)
+    out = np.zeros(32, dtype=np.uint8)
+    lib.create_commitment(
+        _ptr(leaves), n, leaf_len,
+        sizes_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(sizes_arr), _ptr(out),
+    )
+    return out.tobytes()
 
 
 def gf_matmul_axes(D: np.ndarray, X: np.ndarray, nthreads: int = 0) -> np.ndarray:

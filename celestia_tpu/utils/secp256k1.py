@@ -13,6 +13,7 @@ from __future__ import annotations
 import hashlib
 import hmac
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Optional, Tuple
 
 # Curve parameters (SEC2 secp256k1)
@@ -121,18 +122,10 @@ class PublicKey:
 
     @classmethod
     def from_compressed(cls, raw: bytes) -> "PublicKey":
-        if len(raw) != 33 or raw[0] not in (2, 3):
-            raise ValueError("invalid compressed pubkey")
-        x = int.from_bytes(raw[1:], "big")
-        if x >= P:
-            raise ValueError("pubkey x out of range")
-        y2 = (pow(x, 3, P) + 7) % P
-        y = pow(y2, (P + 1) // 4, P)
-        if y * y % P != y2:
-            raise ValueError("point not on curve")
-        if (y & 1) != (raw[0] & 1):
-            y = P - y
-        return cls(x, y)
+        # decompression costs a modular sqrt; the same few pubkeys repeat
+        # across a block's txs, so memoize (instances are frozen).  The
+        # cached helper raises for invalid encodings like the inline path.
+        return _decompress_cached(bytes(raw))
 
     def verify(self, msg: bytes, sig: bytes) -> bool:
         pre = _verify_scalars(msg, sig)
@@ -147,6 +140,22 @@ class PublicKey:
     def address(self) -> bytes:
         """20-byte account address: sha256(compressed pubkey)[:20]."""
         return hashlib.sha256(self.compressed()).digest()[:20]
+
+
+@lru_cache(maxsize=4096)
+def _decompress_cached(raw: bytes) -> PublicKey:
+    if len(raw) != 33 or raw[0] not in (2, 3):
+        raise ValueError("invalid compressed pubkey")
+    x = int.from_bytes(raw[1:], "big")
+    if x >= P:
+        raise ValueError("pubkey x out of range")
+    y2 = (pow(x, 3, P) + 7) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        raise ValueError("point not on curve")
+    if (y & 1) != (raw[0] & 1):
+        y = P - y
+    return PublicKey(x, y)
 
 
 MULTISIG_PREFIX = 0xF0

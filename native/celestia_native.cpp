@@ -248,6 +248,48 @@ void eds_nmt_roots(const uint8_t* eds, int k, int B, uint8_t* out) {
     delete[] leaves;
 }
 
+// RFC-6962 merkle root over n leaves of leaf_len bytes (any n >= 1):
+// leaf = sha256(0x00||data), node = sha256(0x01||l||r), split at the
+// largest power of two < n.
+static void rfc6962_rec(const uint8_t* leaves, int n, int leaf_len,
+                        uint8_t* out32) {
+    if (n == 1) {
+        uint8_t buf[1 + 256];
+        buf[0] = 0x00;
+        memcpy(buf + 1, leaves, leaf_len);
+        sha256_one(buf, 1 + leaf_len, out32);
+        return;
+    }
+    int split = 1;
+    while (split * 2 < n) split *= 2;
+    uint8_t lr[1 + 64];
+    lr[0] = 0x01;
+    rfc6962_rec(leaves, split, leaf_len, lr + 1);
+    rfc6962_rec(leaves + (size_t)split * leaf_len, n - split, leaf_len,
+                lr + 33);
+    sha256_one(lr, 65, out32);
+}
+
+// Blob share commitment (go-square/inclusion.CreateCommitment role): the
+// RFC-6962 root over the NMT roots of the blob's merkle-mountain-range
+// subtrees.  leaves: n x leaf_len ns-prefixed shares (contiguous); sizes:
+// m mountain widths summing to n.  One call replaces one ctypes crossing
+// PER SUBTREE (~62/blob) — the host cost that dominated commitment
+// recompute in PrepareProposal/ProcessProposal.
+void create_commitment(const uint8_t* leaves, int n, int leaf_len,
+                       const int32_t* sizes, int m, uint8_t* out32) {
+    (void)n;
+    uint8_t* roots = new uint8_t[(size_t)m * DIGEST];
+    size_t off = 0;
+    for (int i = 0; i < m; i++) {
+        nmt_root(leaves + off * leaf_len, sizes[i], leaf_len,
+                 roots + (size_t)i * DIGEST);
+        off += (size_t)sizes[i];
+    }
+    rfc6962_rec(roots, m, DIGEST, out32);
+    delete[] roots;
+}
+
 // Batched per-axis GF(256) matmul: out[i] = D[i] (rows_out x k) * X[i]
 // (k x B), striped across nthreads threads.  The decode step of
 // rsmt2d.Repair-style reconstruction: one matrix per axis (every axis can

@@ -287,8 +287,12 @@ def test_exec_wrapped_pfb_cannot_bypass_blob_ante():
         MsgExec(GRANTEE_ADDR, (huge,))
     ]))
     assert res.code == 1
-    # either blob decorator may fire first; both must see the wrapped PFB
-    assert "blob gas" in res.log or "square capacity" in res.log
+    # any of the PFB guards may fire first; all must see the wrapped PFB
+    assert (
+        "blob gas" in res.log
+        or "square capacity" in res.log
+        or "missing blobs" in res.log
+    )
 
 
 def test_unknown_invariant_name_errors():
@@ -302,3 +306,21 @@ def test_unknown_invariant_name_errors():
     ]))
     assert res.code == 2
     assert "unknown invariant" in res.log
+
+
+def test_exec_wrapped_pfb_without_blobs_rejected():
+    """Review finding: a PFB wrapped in MsgExec inside a plain (non-BlobTx)
+    tx must be rejected like a direct blob-less PFB."""
+    from celestia_tpu.state.tx import MsgPayForBlobs
+
+    app = fresh_app()
+    pfb = MsgPayForBlobs(
+        signer=GRANTER_ADDR,
+        namespaces=(b"\x00" * 29,),
+        blob_sizes=(478,),
+        share_commitments=(b"\x00" * 32,),
+        share_versions=(0,),
+    )
+    res = app.check_tx(signed(GRANTEE, app, [MsgExec(GRANTEE_ADDR, (pfb,))]))
+    assert res.code == 1
+    assert "missing blobs" in res.log

@@ -204,3 +204,52 @@ def test_nmt_node_rule_from_spec_formula():
     node = nmt_ops.combine_digests_np(dp, dp)
     assert node[:ns] == parity
     assert node[ns : 2 * ns] == parity
+
+
+def test_native_commitment_matches_python_path():
+    """The one-call native create_commitment must be bit-identical to the
+    per-subtree host path across mountain-range shapes (incl. non-power-of-2
+    mountain counts, where RFC-6962's uneven split kicks in)."""
+    import numpy as np
+
+    from celestia_tpu.appconsts import (
+        DEFAULT_SUBTREE_ROOT_THRESHOLD,
+        NAMESPACE_SIZE,
+    )
+    from celestia_tpu.da import inclusion
+    from celestia_tpu.da.blob import Blob
+    from celestia_tpu.da.namespace import Namespace
+    from celestia_tpu.da.shares import shares_to_array, split_blob_into_shares
+    from celestia_tpu.da.square import subtree_width
+    from celestia_tpu.ops import nmt as nmt_ops
+    from celestia_tpu.utils import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(7)
+    for nbytes in (1, 478, 479, 5000, 57000, 200000):
+        blob = Blob(
+            Namespace.v0(b"\x07" * 10),
+            rng.integers(0, 256, nbytes, dtype=np.uint8).tobytes(),
+        )
+        got = inclusion.create_commitment(blob)
+        shares = split_blob_into_shares(
+            blob.namespace, blob.data, blob.share_version
+        )
+        arr = shares_to_array(shares)
+        n = arr.shape[0]
+        sizes = inclusion.merkle_mountain_range_sizes(
+            n, subtree_width(n, DEFAULT_SUBTREE_ROOT_THRESHOLD)
+        )
+        ns = np.broadcast_to(
+            np.frombuffer(blob.namespace.raw, dtype=np.uint8),
+            (n, NAMESPACE_SIZE),
+        )
+        leaves = np.ascontiguousarray(np.concatenate([ns, arr], axis=1))
+        roots, off = [], 0
+        for s in sizes:
+            roots.append(inclusion._nmt_root_host(leaves[off : off + s]))
+            off += s
+        assert got == nmt_ops.rfc6962_root_np(roots).tobytes(), nbytes

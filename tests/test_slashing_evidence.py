@@ -252,3 +252,33 @@ def test_intermittent_signing_does_not_jail():
             votes=[(VAL, i % 2 == 0), (OTHER, True)],  # sign every other block
         )
     assert not app.staking.validator(VAL).jailed
+
+
+def test_slash_settles_distribution_rewards_first():
+    """Review finding: a slash must settle F1 reference points, or stale
+    stake over-pays rewards and drains the distribution account."""
+    from celestia_tpu.state.bank import FEE_COLLECTOR
+    from celestia_tpu.state.invariants import assert_invariants
+    from celestia_tpu.state.tx import MsgDelegate
+
+    app = fresh_app()
+    app.begin_block(2, app.genesis_time_ns + 10**9)
+    assert app.deliver_tx(signed(OTHER_KEY, app, [
+        MsgDelegate(OTHER, VAL, 100_000_000)
+    ])).code == 0
+    # accrue rewards at the pre-slash stake
+    app.bank.mint(FEE_COLLECTOR, 1_000_000)
+    app.distribution.allocate_tokens(None, None)
+    pending_before = app.distribution.pending_rewards(OTHER, VAL)
+    assert pending_before > 0
+    bal_before = app.bank.balance(OTHER)
+    app.staking.slash(VAL, 500_000)  # 50%
+    # the slash settled (paid) the accrued rewards and re-anchored
+    assert app.bank.balance(OTHER) == bal_before + pending_before
+    assert app.distribution.pending_rewards(OTHER, VAL) == 0
+    # post-slash accrual uses the REDUCED stake; solvency holds throughout
+    app.bank.mint(FEE_COLLECTOR, 1_000_000)
+    app.distribution.allocate_tokens(None, None)
+    app.distribution.withdraw_delegator_reward(OTHER, VAL)
+    app.distribution.withdraw_delegator_reward(VAL, VAL)
+    assert_invariants(app)

@@ -384,6 +384,58 @@ def cmd_query(args) -> int:
         )))
     elif args.query_cmd == "invariants":
         print(json.dumps(node.abci_query("custom/crisis/invariants", {})))
+    elif args.query_cmd == "namespace-shares":
+        # fetch + VERIFY all shares of a namespace like a rollup would
+        from celestia_tpu.da import namespace_data as nsd_mod
+        from celestia_tpu.da.dah import DataAvailabilityHeader
+
+        out = node.abci_query(
+            "custom/namespace/shares",
+            {"height": args.height, "namespace": args.namespace},
+        )
+        rows = tuple(bytes.fromhex(r) for r in out["dah"]["row_roots"])
+        cols = tuple(bytes.fromhex(c) for c in out["dah"]["col_roots"])
+        dah = DataAvailabilityHeader(
+            rows, cols, DataAvailabilityHeader.compute_hash(rows, cols)
+        )
+        result = nsd_mod.NamespaceData.from_dict(out["data"])
+        verified = (
+            dah.hash == bytes.fromhex(out["data_root"])
+            and result.verify(dah)
+        )
+        print(json.dumps({
+            "verified": verified,
+            "rows": len(result.rows),
+            "shares": sum(len(r.shares) for r in result.rows),
+            "payload_hex": result.blobs_payload().hex() if verified else "",
+        }))
+    elif args.query_cmd == "das-sample":
+        # fetch + VERIFY n random samples like a light client would
+        from celestia_tpu.da import das as das_mod
+
+        blk = node.block(int(args.height))
+        lc = das_mod.LightClient(
+            bytes.fromhex(blk["data_root"]), int(blk["square_size"]),
+            seed=int(args.seed),
+        )
+
+        def fetch(r, c):
+            out = node.abci_query(
+                "custom/das/sample",
+                {"height": args.height, "row": r, "col": c},
+            )
+            return das_mod.SampleProof.from_dict(out["proof"])
+
+        result = lc.sample(fetch, int(args.samples))
+        print(json.dumps({
+            "available": result.available,
+            "verified": result.verified,
+            "confidence": round(result.confidence, 6),
+            "failed": [
+                {"row": r, "col": c, "reason": why}
+                for r, c, why in result.failed
+            ],
+        }))
     return 0
 
 
@@ -640,6 +692,15 @@ def build_parser() -> argparse.ArgumentParser:
     q = qs.add_parser("signing-info")
     q.add_argument("validator")
     qs.add_parser("invariants")
+    q = qs.add_parser("das-sample", help="light-client availability sampling")
+    q.add_argument("height", type=int)
+    q.add_argument("--samples", type=int, default=16)
+    q.add_argument("--seed", type=int, default=0)
+    q = qs.add_parser(
+        "namespace-shares", help="all shares of a namespace, verified"
+    )
+    q.add_argument("height", type=int)
+    q.add_argument("namespace", help="29-byte namespace, hex")
     sp.set_defaults(fn=cmd_query)
 
     sp = sub.add_parser("status", help="node status")

@@ -1,0 +1,240 @@
+"""Data-availability sampling (DAS): the light-client protocol the EDS
+exists for.
+
+Role: sampling-based availability verification — the reference ecosystem's
+light nodes sample random EDS cells with NMT proofs so *no single node
+needs the full square* (SURVEY.md §5 "long-context analogue"; the
+2x-extension guarantees any withheld original data forces >= 75% of cells
+to be withheld, spec `specs/src/specs/data_structures.md`).  celestia-app
+itself serves the data; the DAS client lives beside it the way
+celestia-node's light client does — here both halves are native to this
+framework:
+
+  SampleProof   — one EDS cell + its row-NMT range proof + the row root's
+                  membership proof in the data root.
+  sample_proof  — prover (node side), serving any cell of the 2k x 2k EDS
+                  (all four quadrants, with the Q0/parity namespace rule).
+  LightClient   — verifier: samples coordinates uniformly with a local
+                  seed, verifies every proof against the header's data
+                  root, and reports the soundness bound
+                  P[withheld block undetected] <= (3/4)^n.
+
+Host hashing: one sample touches a single 2k-leaf tree; the per-level
+device dispatches would cost more in launch latency than the ~2k SHA-256
+calls cost on the host, so the prover hashes rows host-side (native C++
+when available).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from celestia_tpu.appconsts import NAMESPACE_SIZE, SHARE_SIZE
+from celestia_tpu.da.dah import DataAvailabilityHeader, ExtendedDataSquare
+from celestia_tpu.da.namespace import PARITY_SHARE_NAMESPACE
+from celestia_tpu.da.proof import (
+    MerkleProof,
+    NmtRangeProof,
+    merkle_proof,
+    nmt_range_proof_from_levels,
+)
+from celestia_tpu.ops import nmt as nmt_ops
+
+
+def _row_leaves(eds: ExtendedDataSquare, row: int) -> np.ndarray:
+    """Namespace-prefixed NMT leaves of one EDS row (Q0 keeps own
+    namespaces; every parity cell gets the parity namespace —
+    pkg/wrapper's Push rule)."""
+    k = eds.square_size
+    cells = np.asarray(eds.shares[row])  # (2k, 512)
+    n = 2 * k
+    prefix = np.empty((n, NAMESPACE_SIZE), dtype=np.uint8)
+    parity_ns = np.frombuffer(PARITY_SHARE_NAMESPACE.raw, dtype=np.uint8)
+    if row < k:
+        prefix[:k] = cells[:k, :NAMESPACE_SIZE]
+        prefix[k:] = parity_ns
+    else:
+        prefix[:] = parity_ns
+    return np.concatenate([prefix, cells], axis=1)
+
+
+def _host_level_stack(leaves: np.ndarray) -> List[np.ndarray]:
+    """NMT level stack of one small tree on the host."""
+    digests = [
+        nmt_ops.leaf_digest_np(leaves[i].tobytes()) for i in range(len(leaves))
+    ]
+    levels = [np.frombuffer(b"".join(digests), dtype=np.uint8).reshape(-1, 90)]
+    while len(digests) > 1:
+        digests = [
+            nmt_ops.combine_digests_np(digests[2 * i], digests[2 * i + 1])
+            for i in range(len(digests) // 2)
+        ]
+        levels.append(
+            np.frombuffer(b"".join(digests), dtype=np.uint8).reshape(-1, 90)
+        )
+    return levels
+
+
+@dataclass(frozen=True)
+class SampleProof:
+    """One sampled EDS cell, provable to the block's data root."""
+
+    row: int
+    col: int
+    square_size: int  # original k
+    share: bytes  # 512-byte cell
+    nmt_proof: NmtRangeProof  # within the row's NMT
+    row_root: bytes
+    root_proof: MerkleProof  # row root -> data root
+
+    def leaf(self) -> bytes:
+        """The ns-prefixed NMT leaf this cell hashes to."""
+        k = self.square_size
+        if self.row < k and self.col < k:
+            prefix = self.share[:NAMESPACE_SIZE]
+        else:
+            prefix = PARITY_SHARE_NAMESPACE.raw
+        return prefix + self.share
+
+    def verify(self, data_root: bytes) -> bool:
+        k = self.square_size
+        if not (0 <= self.row < 2 * k and 0 <= self.col < 2 * k):
+            return False
+        if len(self.share) != SHARE_SIZE:
+            return False
+        if self.nmt_proof.start != self.col or self.nmt_proof.end != self.col + 1:
+            return False
+        if not self.nmt_proof.verify(self.row_root, [self.leaf()], 2 * k):
+            return False
+        # the row root's position among the DAH's 4k roots is its row index
+        if self.root_proof.index != self.row or self.root_proof.total != 4 * k:
+            return False
+        return self.root_proof.verify(data_root, self.row_root)
+
+    def to_dict(self) -> dict:
+        return {
+            "row": self.row,
+            "col": self.col,
+            "square_size": self.square_size,
+            "share": self.share.hex(),
+            "nmt": {
+                "start": self.nmt_proof.start,
+                "end": self.nmt_proof.end,
+                "nodes": [n.hex() for n in self.nmt_proof.nodes],
+            },
+            "row_root": self.row_root.hex(),
+            "root": {
+                "index": self.root_proof.index,
+                "total": self.root_proof.total,
+                "aunts": [a.hex() for a in self.root_proof.aunts],
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SampleProof":
+        return cls(
+            row=int(d["row"]),
+            col=int(d["col"]),
+            square_size=int(d["square_size"]),
+            share=bytes.fromhex(d["share"]),
+            nmt_proof=NmtRangeProof(
+                int(d["nmt"]["start"]),
+                int(d["nmt"]["end"]),
+                tuple(bytes.fromhex(n) for n in d["nmt"]["nodes"]),
+            ),
+            row_root=bytes.fromhex(d["row_root"]),
+            root_proof=MerkleProof(
+                index=int(d["root"]["index"]),
+                total=int(d["root"]["total"]),
+                aunts=tuple(bytes.fromhex(a) for a in d["root"]["aunts"]),
+            ),
+        )
+
+
+def sample_proof(
+    eds: ExtendedDataSquare,
+    dah: DataAvailabilityHeader,
+    row: int,
+    col: int,
+) -> SampleProof:
+    """Prove one EDS cell (any quadrant) to the data root."""
+    k = eds.square_size
+    if not (0 <= row < 2 * k and 0 <= col < 2 * k):
+        raise ValueError(f"sample ({row}, {col}) outside the {2*k}x{2*k} EDS")
+    levels = _host_level_stack(_row_leaves(eds, row))
+    nmt_proof = nmt_range_proof_from_levels(levels, col, col + 1)
+    all_roots = list(dah.row_roots) + list(dah.col_roots)
+    return SampleProof(
+        row=row,
+        col=col,
+        square_size=k,
+        share=np.asarray(eds.shares[row, col]).tobytes(),
+        nmt_proof=nmt_proof,
+        row_root=dah.row_roots[row],
+        root_proof=merkle_proof(all_roots, row),
+    )
+
+
+@dataclass
+class SampleResult:
+    coordinates: List[Tuple[int, int]]
+    verified: int
+    failed: List[Tuple[int, int, str]]  # (row, col, reason)
+
+    @property
+    def available(self) -> bool:
+        return not self.failed
+
+    @property
+    def confidence(self) -> float:
+        """P[an unavailable block would have escaped detection] is at most
+        (3/4)^n: recovering a withheld share requires withholding > 25% of
+        the EDS (k+1 of 2k cells in some axis), so each uniformly-sampled
+        cell is withheld with probability > 1/4."""
+        return 1.0 - 0.75 ** self.verified
+
+
+class LightClient:
+    """DAS verifier: trusts only a header (data root + square size)."""
+
+    def __init__(self, data_root: bytes, square_size: int, seed: int = 0):
+        self.data_root = data_root
+        self.k = square_size
+        self._rng = np.random.default_rng(seed)
+
+    def pick_coordinates(self, n: int) -> List[Tuple[int, int]]:
+        n_axis = 2 * self.k
+        flat = self._rng.choice(n_axis * n_axis, size=min(n, n_axis * n_axis),
+                                replace=False)
+        return [(int(f) // n_axis, int(f) % n_axis) for f in flat]
+
+    def sample(
+        self,
+        fetch: Callable[[int, int], Optional[SampleProof]],
+        n_samples: int = 16,
+    ) -> SampleResult:
+        """Fetch + verify n uniformly-random cells.  A None response, a
+        proof for the wrong coordinate, or a proof that fails verification
+        all count as withheld — a provider must PROVE every sampled cell."""
+        coords = self.pick_coordinates(n_samples)
+        verified = 0
+        failed: List[Tuple[int, int, str]] = []
+        for row, col in coords:
+            proof = fetch(row, col)
+            if proof is None:
+                failed.append((row, col, "not served"))
+                continue
+            if (proof.row, proof.col) != (row, col):
+                failed.append((row, col, "proof for the wrong coordinate"))
+                continue
+            if proof.square_size != self.k:
+                failed.append((row, col, "square size mismatch"))
+                continue
+            if not proof.verify(self.data_root):
+                failed.append((row, col, "proof does not verify"))
+                continue
+            verified += 1
+        return SampleResult(coords, verified, failed)

@@ -76,6 +76,37 @@ class NmtRangeProof:
         got = compute(0, tree_size)
         return got == root and not nodes
 
+    def verify_complete_namespace(
+        self, root: bytes, leaves: Sequence[bytes], tree_size: int,
+        namespace: bytes,
+    ) -> bool:
+        """Verify the range AND that it covers every leaf of ``namespace``
+        in the tree: each sibling subtree left of the range must end below
+        the namespace, each right sibling must start above it (their
+        min/max namespaces are embedded in the 90-byte digests — the NMT
+        property that makes per-namespace retrieval trustlessly complete)."""
+        if not self.verify(root, leaves, tree_size):
+            return False
+        for l in leaves:
+            if l[:NAMESPACE_SIZE] != namespace:
+                return False  # foreign leaf smuggled into the range
+        nodes = list(self.nodes)
+
+        def walk(lo: int, hi: int) -> bool:
+            if lo >= self.end or hi <= self.start:
+                node = nodes.pop(0)
+                node_min = node[:NAMESPACE_SIZE]
+                node_max = node[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
+                if hi <= self.start:  # entirely left of the range
+                    return node_max < namespace
+                return node_min > namespace  # entirely right
+            if hi - lo == 1:
+                return True
+            mid = (lo + hi) // 2
+            return walk(lo, mid) and walk(mid, hi)
+
+        return walk(0, tree_size)
+
 
 def nmt_range_proof_from_levels(
     levels: List[np.ndarray], start: int, end: int

@@ -517,6 +517,38 @@ class TestNode:
             from celestia_tpu.state.invariants import assert_invariants
 
             return assert_invariants(self.app)
+        if path == "custom/namespace/shares":
+            # GetSharesByNamespace: all shares of one namespace + proofs,
+            # with the DAH so a light client can verify completeness
+            # against its trusted data root
+            from celestia_tpu.da import namespace_data as nsd
+
+            height = int(data["height"])
+            art = self._block_artifacts(height)
+            result = nsd.get_shares_by_namespace(
+                art["eds"], art["dah"], bytes.fromhex(data["namespace"])
+            )
+            return {
+                "data": result.to_dict(),
+                "dah": {
+                    "row_roots": [r.hex() for r in art["dah"].row_roots],
+                    "col_roots": [c.hex() for c in art["dah"].col_roots],
+                },
+                "data_root": self.data_root(height).hex(),
+            }
+        if path == "custom/das/sample":
+            # DAS serving surface: one EDS cell + proof to the data root
+            from celestia_tpu.da import das as das_mod
+
+            height = int(data["height"])
+            art = self._block_artifacts(height)
+            proof = das_mod.sample_proof(
+                art["eds"], art["dah"], int(data["row"]), int(data["col"])
+            )
+            return {
+                "proof": proof.to_dict(),
+                "data_root": self.data_root(height).hex(),
+            }
         if path == "custom/proof/share":
             height = int(data["height"])
             art = self._block_artifacts(height)

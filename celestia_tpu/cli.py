@@ -399,8 +399,14 @@ def cmd_query(args) -> int:
             rows, cols, DataAvailabilityHeader.compute_hash(rows, cols)
         )
         result = nsd_mod.NamespaceData.from_dict(out["data"])
+        # trust anchor: the block header's recorded data root, NOT the
+        # query response; and the response must answer for the namespace
+        # that was ASKED (a self-consistent answer for a different
+        # namespace or block must not print verified)
+        trusted_root = bytes.fromhex(node.block(int(args.height))["data_root"])
         verified = (
-            dah.hash == bytes.fromhex(out["data_root"])
+            result.namespace == bytes.fromhex(args.namespace)
+            and dah.hash == trusted_root
             and result.verify(dah)
         )
         print(json.dumps({

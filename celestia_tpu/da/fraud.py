@@ -1,0 +1,216 @@
+"""Bad-encoding fraud proofs (BEFP): disprove a maliciously-encoded square.
+
+Role: the fraud-proof half of the availability story (reference spec
+`specs/src/specs/fraud_proofs.md`): if a proposer commits DAH roots over a
+square that is NOT a Reed-Solomon codeword, any full node that notices can
+produce a compact proof that convinces a light client to reject the header
+— k shares of the broken axis, each proven against the ORTHOGONAL axis's
+committed root, whose RS completion hashes to a different root than the
+one committed for the broken axis.
+
+Soundness: the k shares are pinned by NMT proofs to roots inside the same
+DAH the light client already holds, and RS decoding from ANY k points of a
+codeword reproduces the codeword — so if the recomputed axis root differs
+from the committed one, the committed axis cannot be a codeword, no matter
+which k positions the prover picked.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from celestia_tpu.appconsts import NAMESPACE_SIZE, SHARE_SIZE
+from celestia_tpu.da.dah import DataAvailabilityHeader
+from celestia_tpu.da.das import _host_level_stack
+from celestia_tpu.da.namespace import PARITY_SHARE_NAMESPACE
+from celestia_tpu.da.proof import NmtRangeProof, nmt_range_proof_from_levels
+from celestia_tpu.ops import gf256
+
+_PARITY_NS = PARITY_SHARE_NAMESPACE.raw
+
+AXIS_ROW = "row"
+AXIS_COL = "col"
+
+
+def _cell_prefix(row: int, col: int, k: int, share: bytes) -> bytes:
+    """Q0 cells keep their own namespace; parity cells get the parity
+    namespace (the wrapper Push rule both axis trees share)."""
+    if row < k and col < k:
+        return share[:NAMESPACE_SIZE]
+    return _PARITY_NS
+
+
+def _axis_leaves(cells: np.ndarray, axis: str, index: int, k: int) -> np.ndarray:
+    """NMT leaves of one full axis given its 2k cells."""
+    n = 2 * k
+    out = np.empty((n, NAMESPACE_SIZE + SHARE_SIZE), dtype=np.uint8)
+    for j in range(n):
+        r, c = (index, j) if axis == AXIS_ROW else (j, index)
+        share = cells[j].tobytes()
+        out[j, :NAMESPACE_SIZE] = np.frombuffer(
+            _cell_prefix(r, c, k, share), dtype=np.uint8
+        )
+        out[j, NAMESPACE_SIZE:] = cells[j]
+    return out
+
+
+def _axis_root(cells: np.ndarray, axis: str, index: int, k: int) -> bytes:
+    levels = _host_level_stack(_axis_leaves(cells, axis, index, k))
+    return levels[-1][0].tobytes()
+
+
+@dataclass(frozen=True)
+class BadEncodingProof:
+    """Proof that the committed axis `index` is not an RS codeword."""
+
+    axis: str  # AXIS_ROW / AXIS_COL
+    index: int
+    square_size: int  # original k
+    positions: Tuple[int, ...]  # k distinct positions along the axis
+    shares: Tuple[bytes, ...]  # the committed cells at those positions
+    # share i proven at leaf `index` of the ORTHOGONAL tree positions[i]
+    proofs: Tuple[NmtRangeProof, ...]
+
+    def verify(self, dah: DataAvailabilityHeader) -> bool:
+        """True iff the fraud is PROVEN against this DAH (a True result
+        means the header must be rejected)."""
+        k = self.square_size
+        n = 2 * k
+        if self.axis not in (AXIS_ROW, AXIS_COL):
+            return False
+        if not 0 <= self.index < n:
+            return False
+        if len(dah.row_roots) != n or len(dah.col_roots) != n:
+            return False
+        if len(self.positions) != k or len(set(self.positions)) != k:
+            return False
+        if len(self.shares) != k or len(self.proofs) != k:
+            return False
+        if any(len(s) != SHARE_SIZE for s in self.shares):
+            return False
+        orth_roots = (
+            dah.col_roots if self.axis == AXIS_ROW else dah.row_roots
+        )
+        for pos, share, proof in zip(self.positions, self.shares, self.proofs):
+            if not 0 <= pos < n:
+                return False
+            # cell (index, pos) for a row sits at leaf `index` of column
+            # pos's tree (and symmetrically for columns)
+            if proof.start != self.index or proof.end != self.index + 1:
+                return False
+            r, c = (
+                (self.index, pos) if self.axis == AXIS_ROW else (pos, self.index)
+            )
+            leaf = _cell_prefix(r, c, k, share) + share
+            if not proof.verify(orth_roots[pos], [leaf], n):
+                return False
+        # reconstruct the full axis from the k proven cells
+        D = gf256.decode_matrices_batch(
+            np.asarray([self.positions], dtype=np.uint8), k
+        )[0]  # (2k, k)
+        X = np.frombuffer(b"".join(self.shares), dtype=np.uint8).reshape(
+            k, SHARE_SIZE
+        )
+        full = gf256.gf_matmul(D, X)
+        committed_root = (
+            dah.row_roots[self.index]
+            if self.axis == AXIS_ROW
+            else dah.col_roots[self.index]
+        )
+        recomputed = _axis_root(full, self.axis, self.index, k)
+        return recomputed != committed_root
+
+    def to_dict(self) -> dict:
+        return {
+            "axis": self.axis,
+            "index": self.index,
+            "square_size": self.square_size,
+            "positions": list(self.positions),
+            "shares": [s.hex() for s in self.shares],
+            "proofs": [
+                {"start": p.start, "end": p.end,
+                 "nodes": [x.hex() for x in p.nodes]}
+                for p in self.proofs
+            ],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BadEncodingProof":
+        return cls(
+            axis=d["axis"],
+            index=int(d["index"]),
+            square_size=int(d["square_size"]),
+            positions=tuple(int(p) for p in d["positions"]),
+            shares=tuple(bytes.fromhex(s) for s in d["shares"]),
+            proofs=tuple(
+                NmtRangeProof(
+                    int(p["start"]), int(p["end"]),
+                    tuple(bytes.fromhex(x) for x in p["nodes"]),
+                )
+                for p in d["proofs"]
+            ),
+        )
+
+
+def detect_bad_encoding(
+    eds_shares: np.ndarray, dah: DataAvailabilityHeader
+) -> Optional[Tuple[str, int]]:
+    """Full-node detection: find an axis whose committed cells are not an
+    RS codeword (reconstructing from its first k cells disagrees with the
+    rest).  Returns (axis, index) or None for an honestly-encoded square."""
+    eds_shares = np.asarray(eds_shares, dtype=np.uint8)
+    n = eds_shares.shape[0]
+    k = n // 2
+    D = gf256.decode_matrices_batch(
+        np.arange(k, dtype=np.uint8)[None, :], k
+    )[0]
+    for axis in (AXIS_ROW, AXIS_COL):
+        data = eds_shares if axis == AXIS_ROW else eds_shares.transpose(1, 0, 2)
+        for idx in range(n):
+            full = gf256.gf_matmul(D, data[idx, :k])
+            if not np.array_equal(full, data[idx]):
+                return axis, idx
+    return None
+
+
+def build_befp(
+    eds_shares: np.ndarray,
+    dah: DataAvailabilityHeader,
+    axis: str,
+    index: int,
+    positions: Optional[Tuple[int, ...]] = None,
+) -> BadEncodingProof:
+    """Prover: package k cells of the broken axis with proofs against the
+    orthogonal axis roots."""
+    eds_shares = np.asarray(eds_shares, dtype=np.uint8)
+    n = eds_shares.shape[0]
+    k = n // 2
+    if positions is None:
+        positions = tuple(range(k))
+    shares: List[bytes] = []
+    proofs: List[NmtRangeProof] = []
+    for pos in positions:
+        r, c = (index, pos) if axis == AXIS_ROW else (pos, index)
+        share = eds_shares[r, c].tobytes()
+        # build the orthogonal tree (column pos for a row, row pos for a
+        # column) and prove leaf `index` in it
+        orth_axis = AXIS_COL if axis == AXIS_ROW else AXIS_ROW
+        orth_cells = (
+            eds_shares[:, pos] if orth_axis == AXIS_COL else eds_shares[pos]
+        )
+        levels = _host_level_stack(
+            _axis_leaves(orth_cells, orth_axis, pos, k)
+        )
+        proofs.append(nmt_range_proof_from_levels(levels, index, index + 1))
+        shares.append(share)
+    return BadEncodingProof(
+        axis=axis,
+        index=index,
+        square_size=k,
+        positions=tuple(positions),
+        shares=tuple(shares),
+        proofs=tuple(proofs),
+    )

@@ -33,26 +33,6 @@ def root_namespace_range(root: bytes) -> Tuple[bytes, bytes]:
     return root[:NAMESPACE_SIZE], root[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
 
 
-def _left_siblings_below(
-    proof: NmtRangeProof, tree_size: int, namespace: bytes
-) -> bool:
-    """True iff every sibling subtree left of the proof's range has
-    max namespace < the target (no target share hides left of it)."""
-    nodes = list(proof.nodes)
-
-    def walk(lo: int, hi: int) -> bool:
-        if lo >= proof.end or hi <= proof.start:
-            node = nodes.pop(0)
-            if hi <= proof.start:  # left sibling
-                node_max = node[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
-                return node_max < namespace
-            return True  # right siblings unconstrained for absence
-        if hi - lo == 1:
-            return True
-        mid = (lo + hi) // 2
-        return walk(lo, mid) and walk(mid, hi)
-
-    return walk(0, tree_size)
 
 
 @dataclass(frozen=True)
@@ -130,7 +110,11 @@ class NamespaceData:
                     root, [entry.absence_leaf], 2 * k
                 ):
                     return False
-                if not _left_siblings_below(entry.proof, 2 * k, ns):
+                # right siblings are unconstrained for absence (namespace
+                # ordering + one above-target witness already close the gap)
+                if not entry.proof.sibling_namespace_bounds(
+                    2 * k, ns, check_right=False
+                ):
                     return False
                 continue
             if len(entry.shares) != entry.end - entry.start:
@@ -183,6 +167,26 @@ class NamespaceData:
         )
 
 
+def _level_stacks_for_rows(
+    eds: ExtendedDataSquare, row_idxs: List[int]
+) -> List[List[np.ndarray]]:
+    """Level stacks for the given rows.  A handful of rows hash on the
+    host (device launch latency would dominate); wide requests go through
+    the batched device kernel in log2(2k) dispatches total — the same
+    trade new_share_inclusion_proof makes."""
+    if len(row_idxs) <= 4:
+        return [
+            _host_level_stack(_row_leaves(eds, r)) for r in row_idxs
+        ]
+    import jax
+
+    leaves = np.stack([_row_leaves(eds, r) for r in row_idxs])  # (R, 2k, L)
+    batched = [
+        np.asarray(lv) for lv in nmt_ops.nmt_level_stack(jax.numpy.asarray(leaves))
+    ]
+    return [[lv[i] for lv in batched] for i in range(len(row_idxs))]
+
+
 def get_shares_by_namespace(
     eds: ExtendedDataSquare,
     dah: DataAvailabilityHeader,
@@ -193,8 +197,11 @@ def get_shares_by_namespace(
     skipped — the roots themselves prove the absence."""
     if len(namespace) != NAMESPACE_SIZE:
         raise ValueError(f"namespace must be {NAMESPACE_SIZE} bytes")
+    if namespace >= PARITY_NS:
+        raise ValueError("the parity namespace is not queryable data")
     k = eds.square_size
-    rows: List[RowNamespaceData] = []
+    # phase 1: classify covered rows (present range vs absence witness)
+    plan: List[Tuple[int, int, int, bool]] = []  # (row, start, end, absent)
     for row_idx in range(2 * k):
         ns_min, ns_max = root_namespace_range(dah.row_roots[row_idx])
         if not (ns_min <= namespace <= ns_max):
@@ -217,8 +224,22 @@ def get_shares_by_namespace(
                 ),
                 k,  # everything below target: first parity cell witnesses
             )
-            levels = _host_level_stack(_row_leaves(eds, row_idx))
-            proof = nmt_range_proof_from_levels(levels, witness, witness + 1)
+            plan.append((row_idx, witness, witness + 1, True))
+            continue
+        start, end = cols[0], cols[-1] + 1
+        if cols != list(range(start, end)):
+            raise ValueError(
+                f"namespace {namespace.hex()} not contiguous in row {row_idx}"
+            )
+        plan.append((row_idx, start, end, False))
+    # phase 2: one (possibly batched) level-stack pass over covered rows
+    stacks = _level_stacks_for_rows(eds, [p[0] for p in plan])
+    rows: List[RowNamespaceData] = []
+    for (row_idx, start, end, absent), levels in zip(plan, stacks):
+        proof = nmt_range_proof_from_levels(levels, start, end)
+        cells = np.asarray(eds.shares[row_idx])
+        if absent:
+            witness = start
             leaf_prefix = (
                 cells[witness, :NAMESPACE_SIZE].tobytes()
                 if witness < k
@@ -226,26 +247,19 @@ def get_shares_by_namespace(
             )
             rows.append(
                 RowNamespaceData(
-                    row=row_idx, start=witness, end=witness + 1,
-                    shares=(), proof=proof,
+                    row=row_idx, start=start, end=end, shares=(),
+                    proof=proof,
                     absence_leaf=leaf_prefix + cells[witness].tobytes(),
                 )
             )
-            continue
-        start, end = cols[0], cols[-1] + 1
-        if cols != list(range(start, end)):
-            raise ValueError(
-                f"namespace {namespace.hex()} not contiguous in row {row_idx}"
+        else:
+            rows.append(
+                RowNamespaceData(
+                    row=row_idx, start=start, end=end,
+                    shares=tuple(
+                        cells[c].tobytes() for c in range(start, end)
+                    ),
+                    proof=proof,
+                )
             )
-        levels = _host_level_stack(_row_leaves(eds, row_idx))
-        proof = nmt_range_proof_from_levels(levels, start, end)
-        rows.append(
-            RowNamespaceData(
-                row=row_idx,
-                start=start,
-                end=end,
-                shares=tuple(cells[c].tobytes() for c in range(start, end)),
-                proof=proof,
-            )
-        )
     return NamespaceData(namespace=namespace, square_size=k, rows=tuple(rows))

@@ -76,6 +76,32 @@ class NmtRangeProof:
         got = compute(0, tree_size)
         return got == root and not nodes
 
+    def sibling_namespace_bounds(
+        self, tree_size: int, namespace: bytes, check_right: bool = True
+    ) -> bool:
+        """Walk the proof's sibling digests in the SAME traversal order
+        verify() consumes them and check their embedded namespace ranges
+        against the target: every left sibling must end below it, and
+        (when ``check_right``) every right sibling must start above it.
+        The single source of truth for sibling ordering — completeness and
+        absence verification both ride on it."""
+        nodes = list(self.nodes)
+
+        def walk(lo: int, hi: int) -> bool:
+            if lo >= self.end or hi <= self.start:
+                node = nodes.pop(0)
+                if hi <= self.start:  # entirely left of the range
+                    return node[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE] < namespace
+                if check_right:  # entirely right
+                    return node[:NAMESPACE_SIZE] > namespace
+                return True
+            if hi - lo == 1:
+                return True
+            mid = (lo + hi) // 2
+            return walk(lo, mid) and walk(mid, hi)
+
+        return walk(0, tree_size)
+
     def verify_complete_namespace(
         self, root: bytes, leaves: Sequence[bytes], tree_size: int,
         namespace: bytes,
@@ -90,22 +116,7 @@ class NmtRangeProof:
         for l in leaves:
             if l[:NAMESPACE_SIZE] != namespace:
                 return False  # foreign leaf smuggled into the range
-        nodes = list(self.nodes)
-
-        def walk(lo: int, hi: int) -> bool:
-            if lo >= self.end or hi <= self.start:
-                node = nodes.pop(0)
-                node_min = node[:NAMESPACE_SIZE]
-                node_max = node[NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
-                if hi <= self.start:  # entirely left of the range
-                    return node_max < namespace
-                return node_min > namespace  # entirely right
-            if hi - lo == 1:
-                return True
-            mid = (lo + hi) // 2
-            return walk(lo, mid) and walk(mid, hi)
-
-        return walk(0, tree_size)
+        return self.sibling_namespace_bounds(tree_size, namespace)
 
 
 def nmt_range_proof_from_levels(

@@ -156,11 +156,14 @@ class BadEncodingProof:
 
 
 def detect_bad_encoding(
-    eds_shares: np.ndarray, dah: DataAvailabilityHeader
+    eds_shares: np.ndarray,
 ) -> Optional[Tuple[str, int]]:
     """Full-node detection: find an axis whose committed cells are not an
     RS codeword (reconstructing from its first k cells disagrees with the
-    rest).  Returns (axis, index) or None for an honestly-encoded square."""
+    rest).  Returns (axis, index) or None for an honestly-encoded square.
+
+    Operates on the shares alone — codeword-ness is a property of the
+    square; the DAH only enters when a BEFP is VERIFIED against it."""
     eds_shares = np.asarray(eds_shares, dtype=np.uint8)
     n = eds_shares.shape[0]
     k = n // 2
@@ -178,13 +181,13 @@ def detect_bad_encoding(
 
 def build_befp(
     eds_shares: np.ndarray,
-    dah: DataAvailabilityHeader,
     axis: str,
     index: int,
     positions: Optional[Tuple[int, ...]] = None,
 ) -> BadEncodingProof:
-    """Prover: package k cells of the broken axis with proofs against the
-    orthogonal axis roots."""
+    """Prover: package k cells of the broken axis with proofs computed
+    from the square itself (they bind to whatever DAH committed these
+    shares; verification supplies that DAH)."""
     eds_shares = np.asarray(eds_shares, dtype=np.uint8)
     n = eds_shares.shape[0]
     k = n // 2

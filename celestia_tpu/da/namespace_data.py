@@ -24,6 +24,7 @@ from celestia_tpu.da.dah import DataAvailabilityHeader, ExtendedDataSquare
 from celestia_tpu.da.das import _host_level_stack, _row_leaves
 from celestia_tpu.da.namespace import PARITY_SHARE_NAMESPACE
 from celestia_tpu.da.proof import NmtRangeProof, nmt_range_proof_from_levels
+from celestia_tpu.ops import nmt as nmt_ops
 
 PARITY_NS = PARITY_SHARE_NAMESPACE.raw
 

@@ -37,6 +37,7 @@ from celestia_tpu.appconsts import GOAL_BLOCK_TIME_SECONDS
 from celestia_tpu.node.mempool import Mempool
 from celestia_tpu.node.testnode import Block, BlockHeader
 from celestia_tpu.state.app import App, PreparedProposal
+from celestia_tpu.state.modules.evidence import vote_sign_bytes
 from celestia_tpu.utils.secp256k1 import PrivateKey
 
 
@@ -49,6 +50,11 @@ class Vote:
     validator: str
     accept: bool
     reason: str = ""
+    # consensus-vote signature over (chain_id, height, block_hash): what
+    # makes double-signing provable (x/evidence's Equivocation verifies
+    # exactly these bytes).  Reject votes are nil votes — unsigned.
+    block_hash: bytes = b""
+    signature: bytes = b""
 
 
 @dataclass
@@ -69,10 +75,16 @@ class Validator:
         self.power = power
         self.app = app
         self.mempool = Mempool(max_tx_bytes=64 * 1024 * 1024)
+        # byzantine fixture: also sign a conflicting block hash each
+        # height (the double-sign x/evidence exists to punish)
+        self.double_signs = False
 
     @property
     def address(self) -> bytes:
         return self.key.public_key().address()
+
+    def sign_vote(self, chain_id: str, height: int, block_hash: bytes) -> bytes:
+        return self.key.sign(vote_sign_bytes(chain_id, height, block_hash))
 
 
 class ValidatorNetwork:
@@ -140,6 +152,9 @@ class ValidatorNetwork:
         self.rounds: List[RoundResult] = []
         self._tx_index: Dict[bytes, dict] = {}
         self._now_ns = genesis["genesis_time_ns"]
+        # gossip-observed conflicting signed votes:
+        # (validator, height, hash_a, sig_a, hash_b, sig_b)
+        self.observed_double_signs: List[tuple] = []
 
     # ------------------------------------------------------------------
 
@@ -220,9 +235,39 @@ class ValidatorNetwork:
                 ok, reason = val.app.process_proposal(
                     proposal.block_txs, proposal.square_size, proposal.data_root
                 )
-            votes.append(Vote(val.name, ok, reason))
             if ok:
+                # an accept is a SIGNED vote on the block's data root; a
+                # reject is a nil vote (unsigned)
+                sig = val.sign_vote(self.chain_id, height, proposal.data_root)
+                votes.append(
+                    Vote(val.name, True, reason, proposal.data_root, sig)
+                )
+                if val.double_signs:
+                    # byzantine: a second signature on a conflicting hash,
+                    # gossiped like any vote — observers collect it as
+                    # equivocation evidence
+                    fake = hashlib.sha256(b"conflict" + proposal.data_root).digest()
+                    self.observed_double_signs.append(
+                        (val.address, height,
+                         proposal.data_root, sig,
+                         fake, val.sign_vote(self.chain_id, height, fake))
+                    )
+            else:
+                votes.append(Vote(val.name, False, reason))
+        # only votes with VERIFYING signatures count toward the quorum
+        # (a forged or missing signature is a nil vote)
+        for val, vote in zip(self.validators, votes):
+            if not vote.accept:
+                continue
+            ok_sig = val.key.public_key().verify(
+                vote_sign_bytes(self.chain_id, height, vote.block_hash),
+                vote.signature,
+            )
+            if ok_sig:
                 accept_power += val.power
+            else:
+                vote.accept = False
+                vote.reason = "vote signature invalid"
         committed = accept_power * 3 >= self.total_power * 2
         result = RoundResult(height, proposer.name, committed, votes)
         if committed:

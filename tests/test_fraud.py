@@ -40,18 +40,18 @@ def _corrupt(eds_shares, row, col):
 
 def test_honest_square_yields_no_fraud(honest_block):
     eds_shares, dah = honest_block
-    assert fraud.detect_bad_encoding(eds_shares, dah) is None
+    assert fraud.detect_bad_encoding(eds_shares) is None
     # a BEFP built against an honest axis does NOT verify
-    befp = fraud.build_befp(eds_shares, dah, fraud.AXIS_ROW, 3)
+    befp = fraud.build_befp(eds_shares, fraud.AXIS_ROW, 3)
     assert not befp.verify(dah)
 
 
 def test_corrupted_parity_cell_detected_and_proven(honest_block):
     eds_shares, dah = honest_block
     bad, bad_dah = _corrupt(eds_shares, 2, K + 2)  # Q1 parity cell
-    axis, idx = fraud.detect_bad_encoding(bad, bad_dah)
+    axis, idx = fraud.detect_bad_encoding(bad)
     assert (axis, idx) == (fraud.AXIS_ROW, 2)
-    befp = fraud.build_befp(bad, bad_dah, axis, idx)
+    befp = fraud.build_befp(bad, axis, idx)
     assert befp.verify(bad_dah)
     # the proof does NOT verify against the honest block's DAH (its
     # share proofs bind to the corrupted roots)
@@ -61,9 +61,9 @@ def test_corrupted_parity_cell_detected_and_proven(honest_block):
 def test_corrupted_q0_cell_detected_and_proven(honest_block):
     eds_shares, dah = honest_block
     bad, bad_dah = _corrupt(eds_shares, 1, 3)  # original-data cell
-    axis, idx = fraud.detect_bad_encoding(bad, bad_dah)
+    axis, idx = fraud.detect_bad_encoding(bad)
     assert axis == fraud.AXIS_ROW and idx == 1
-    befp = fraud.build_befp(bad, bad_dah, axis, idx)
+    befp = fraud.build_befp(bad, axis, idx)
     assert befp.verify(bad_dah)
 
 
@@ -72,7 +72,7 @@ def test_befp_from_parity_positions(honest_block):
     eds_shares, dah = honest_block
     bad, bad_dah = _corrupt(eds_shares, 2, 5)
     befp = fraud.build_befp(
-        bad, bad_dah, fraud.AXIS_ROW, 2, positions=tuple(range(K, 2 * K))
+        bad, fraud.AXIS_ROW, 2, positions=tuple(range(K, 2 * K))
     )
     assert befp.verify(bad_dah)
 
@@ -80,8 +80,8 @@ def test_befp_from_parity_positions(honest_block):
 def test_befp_wire_round_trip(honest_block):
     eds_shares, dah = honest_block
     bad, bad_dah = _corrupt(eds_shares, 0, 1)
-    axis, idx = fraud.detect_bad_encoding(bad, bad_dah)
-    befp = fraud.build_befp(bad, bad_dah, axis, idx)
+    axis, idx = fraud.detect_bad_encoding(bad)
+    befp = fraud.build_befp(bad, axis, idx)
     back = fraud.BadEncodingProof.from_dict(befp.to_dict())
     assert back == befp
     assert back.verify(bad_dah)
@@ -91,7 +91,7 @@ def test_tampered_befp_rejected(honest_block):
     """A forged BEFP (wrong shares) cannot frame an honest block: the NMT
     proofs fail against the honest roots."""
     eds_shares, dah = honest_block
-    befp = fraud.build_befp(eds_shares, dah, fraud.AXIS_ROW, 3)
+    befp = fraud.build_befp(eds_shares, fraud.AXIS_ROW, 3)
     forged = fraud.BadEncodingProof(
         befp.axis, befp.index, befp.square_size, befp.positions,
         (b"\x00" * 512,) + befp.shares[1:], befp.proofs,
@@ -105,8 +105,8 @@ def test_column_corruption_detected(honest_block):
     parity-row sweep — either way a verifying BEFP comes out."""
     eds_shares, dah = honest_block
     bad, bad_dah = _corrupt(eds_shares, K + 1, 4)  # parity row, Q0 column
-    found = fraud.detect_bad_encoding(bad, bad_dah)
+    found = fraud.detect_bad_encoding(bad)
     assert found is not None
     axis, idx = found
-    befp = fraud.build_befp(bad, bad_dah, axis, idx)
+    befp = fraud.build_befp(bad, axis, idx)
     assert befp.verify(bad_dah)

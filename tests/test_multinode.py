@@ -193,3 +193,51 @@ def test_network_simulate_and_estimate_gas():
         [MsgSend(signer.address, alice.public_key().address(), 5)]
     )
     assert gas > 0
+
+
+def test_votes_are_signed_and_double_signer_tombstoned():
+    """Consensus votes are real signatures; a validator that double-signs
+    is caught from its OWN gossiped votes, proven on-chain via
+    MsgSubmitEvidence, and tombstoned on every replica."""
+    from celestia_tpu.state.tx import MsgSend, MsgSubmitEvidence
+
+    alice = PrivateKey.from_seed(b"ds-alice")
+    net = ValidatorNetwork(n_validators=4, funded_accounts=[(alice, 10**14)])
+    byz = net.validators[3]
+    byz.double_signs = True
+    # the byzantine validator binds its pubkey with an ordinary tx (the
+    # evidence must verify against it)
+    byz_signer = Signer(net, byz.key)
+    tx = byz_signer.sign_tx([MsgSend(byz.address, alice.public_key().address(), 1)])
+    assert net.broadcast_tx(tx.marshal()).code == 0
+    net.produce_block()
+    assert net.observed_double_signs, "gossip should observe the conflict"
+    val_addr, height, bh_a, sig_a, bh_b, sig_b = net.observed_double_signs[0]
+    assert val_addr == byz.address
+    # every accept vote in the last committed round carries a verifying sig
+    last = net.rounds[-1]
+    assert all(v.signature for v in last.votes if v.accept)
+    # an honest observer submits the evidence on-chain
+    observer = Signer(net, alice)
+    ev_tx = observer.sign_tx([
+        MsgSubmitEvidence(
+            alice.public_key().address(), val_addr, height,
+            net.blocks[-1].header.time_ns, bh_a, sig_a, bh_b, sig_b,
+        )
+    ])
+    assert net.broadcast_tx(ev_tx.marshal()).code == 0
+    blk = net.produce_block()
+    assert all(r.code == 0 for r in blk.tx_results), [
+        r.log for r in blk.tx_results
+    ]
+    # tombstoned + slashed on EVERY replica, and power left the set
+    for val in net.validators:
+        v = val.app.staking.validator(byz.address)
+        assert v.jailed and v.tombstoned
+        assert all(
+            b.operator != byz.address
+            for b in val.app.staking.bonded_validators()
+        )
+    # replicas still agree
+    hashes = {v.app.store.app_hash() for v in net.validators}
+    assert len(hashes) == 1

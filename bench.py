@@ -169,6 +169,55 @@ def _repair_ms(k: int):
     return dt
 
 
+def _filter_txs_ms(n_tx: int = 512):
+    """FilterTxs (ante + native batch sig verify + commitment recompute)
+    over n signed single-blob PFBs — the VERDICT r1 #5 'fast signature
+    verification' acceptance metric, isolated from square build and the
+    device pipeline."""
+    from celestia_tpu.da.blob import Blob, BlobTx
+    from celestia_tpu.da.inclusion import create_commitment
+    from celestia_tpu.da.namespace import Namespace
+    from celestia_tpu.node.testnode import TestNode
+    from celestia_tpu.client.signer import Signer
+    from celestia_tpu.state.tx import MsgPayForBlobs
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+
+    keys = [PrivateKey.from_seed(b"filt-%d" % i) for i in range(8)]
+    node = TestNode(
+        funded_accounts=[(key, 10**15) for key in keys], auto_produce=False
+    )
+    node.app.params.set("blob", "GovMaxSquareSize", 128)
+    rng = np.random.default_rng(6)
+    txs = []
+    for i in range(n_tx):
+        signer = Signer(node, keys[i % len(keys)])
+        ns = Namespace.v0(bytes([i % 250 + 1]) * 10)
+        blob = Blob(ns, rng.integers(0, 256, 2000, dtype=np.uint8).tobytes())
+        msg = MsgPayForBlobs(
+            signer=signer.address,
+            namespaces=(ns.raw,),
+            blob_sizes=(len(blob.data),),
+            share_commitments=(create_commitment(blob),),
+            share_versions=(0,),
+        )
+        tx = signer.sign_tx(
+            [msg], gas_limit=2_000_000, sequence=i // len(keys)
+        )
+        txs.append(BlobTx(tx.marshal(), [blob]).marshal())
+    from celestia_tpu.da import inclusion
+
+    times = []
+    for _ in range(3):
+        # measure the COLD commitment path: tx construction warmed the
+        # content cache, which would otherwise hide codec regressions
+        inclusion._COMMITMENT_CACHE.clear()
+        t0 = time.time()
+        kept = node.app._filter_txs(txs)
+        times.append((time.time() - t0) * 1000.0)
+    assert len(kept) == n_tx, f"filter kept {len(kept)}/{n_tx}"
+    return float(np.median(times))
+
+
 def _prepare_proposal_ms(k: int):
     """Full PrepareProposal over a square's worth of signed PFBs."""
     from celestia_tpu.da.blob import Blob
@@ -243,6 +292,10 @@ def main():
         extras[f"repair_{k}_25pct_ms"] = round(_repair_ms(k), 1)
     except Exception as e:
         extras["repair_error"] = repr(e)[:200]
+    try:
+        extras["filter_512_pfb_ms"] = round(_filter_txs_ms(512), 1)
+    except Exception as e:
+        extras["filter_error"] = repr(e)[:200]
     try:
         batch_ms = _amortized_device_ms(k, batch=BATCH)
         extras[f"batch{BATCH}x{k}_per_square_ms"] = round(batch_ms / BATCH, 3)

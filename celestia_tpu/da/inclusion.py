@@ -62,11 +62,34 @@ def _nmt_root_host(leaves: np.ndarray) -> bytes:
     return level[0]
 
 
+# content-addressed commitment cache: the same blob's commitment is
+# recomputed in CheckTx, FilterTxs AND ProcessProposal (the reference
+# recomputes it at each of those validation points too); the digest key
+# makes a hit deterministic and consensus-safe.  FIFO eviction (dicts are
+# insertion-ordered) so crossing the cap never drops the whole cache
+# mid-proposal.
+_COMMITMENT_CACHE: dict = {}
+_COMMITMENT_CACHE_MAX = 8192
+
+
 def create_commitment(
     blob: Blob, subtree_root_threshold: int = DEFAULT_SUBTREE_ROOT_THRESHOLD
 ) -> bytes:
     """32-byte share commitment of a blob."""
+    import hashlib
+
     from celestia_tpu.da.shares import blob_shares_array
+
+    key = (
+        hashlib.sha256(
+            blob.namespace.raw + blob.share_version.to_bytes(1, "big")
+            + blob.data
+        ).digest(),
+        subtree_root_threshold,
+    )
+    cached = _COMMITMENT_CACHE.get(key)
+    if cached is not None:
+        return cached
 
     arr = blob_shares_array(blob.namespace, blob.data, blob.share_version)
     n = arr.shape[0]
@@ -81,13 +104,18 @@ def create_commitment(
     )  # (n, 541)
     if native.available():
         # one native call per blob (subtree roots + RFC-6962 fold inside)
-        return native.create_commitment(leaves, sizes)
-    roots: List[bytes] = []
-    offset = 0
-    for s in sizes:
-        roots.append(_nmt_root_host(leaves[offset : offset + s]))
-        offset += s
-    return nmt_ops.rfc6962_root_np(roots).tobytes()
+        out = native.create_commitment(leaves, sizes)
+    else:
+        roots: List[bytes] = []
+        offset = 0
+        for s in sizes:
+            roots.append(_nmt_root_host(leaves[offset : offset + s]))
+            offset += s
+        out = nmt_ops.rfc6962_root_np(roots).tobytes()
+    while len(_COMMITMENT_CACHE) >= _COMMITMENT_CACHE_MAX:
+        _COMMITMENT_CACHE.pop(next(iter(_COMMITMENT_CACHE)))
+    _COMMITMENT_CACHE[key] = out
+    return out
 
 
 def create_commitments(blobs: List[Blob]) -> List[bytes]:

@@ -254,20 +254,19 @@ class ValidatorNetwork:
                     )
             else:
                 votes.append(Vote(val.name, False, reason))
-        # only votes with VERIFYING signatures count toward the quorum
-        # (a forged or missing signature is a nil vote)
+        # only votes whose signature verifies over THIS proposal's data
+        # root count toward the quorum — a validly-signed vote on some
+        # other hash is a nil vote here (and evidence fodder elsewhere)
+        digest = vote_sign_bytes(self.chain_id, height, proposal.data_root)
         for val, vote in zip(self.validators, votes):
             if not vote.accept:
                 continue
-            ok_sig = val.key.public_key().verify(
-                vote_sign_bytes(self.chain_id, height, vote.block_hash),
-                vote.signature,
-            )
-            if ok_sig:
+            if vote.block_hash == proposal.data_root and val.key.public_key(
+            ).verify(digest, vote.signature):
                 accept_power += val.power
             else:
                 vote.accept = False
-                vote.reason = "vote signature invalid"
+                vote.reason = "vote signature invalid for this block"
         committed = accept_power * 3 >= self.total_power * 2
         result = RoundResult(height, proposer.name, committed, votes)
         if committed:

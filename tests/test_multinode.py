@@ -241,3 +241,27 @@ def test_votes_are_signed_and_double_signer_tombstoned():
     # replicas still agree
     hashes = {v.app.store.app_hash() for v in net.validators}
     assert len(hashes) == 1
+
+
+def test_vote_for_wrong_block_is_nil():
+    """Review finding: a validly-SIGNED vote on a different hash must not
+    count toward this proposal's quorum."""
+    import hashlib as _h
+
+    net = ValidatorNetwork(n_validators=3)
+    # monkey-patch one validator to vote-accept with a signature over a
+    # conflicting hash (valid signature, wrong block)
+    victim = net.validators[1]
+    orig_sign = victim.sign_vote
+
+    def sign_wrong(chain_id, height, block_hash):
+        return orig_sign(chain_id, height, _h.sha256(b"other" + block_hash).digest())
+
+    victim.sign_vote = sign_wrong
+    blk = net.produce_block()
+    last = net.rounds[-1]
+    bad_vote = next(v for v in last.votes if v.validator == victim.name)
+    assert not bad_vote.accept
+    assert "invalid for this block" in bad_vote.reason
+    # the other 2/3 still commit
+    assert last.committed and blk is not None

@@ -169,11 +169,11 @@ def _repair_ms(k: int):
     return dt
 
 
-def _filter_txs_ms(n_tx: int = 512):
-    """FilterTxs (ante + native batch sig verify + commitment recompute)
-    over n signed single-blob PFBs — the VERDICT r1 #5 'fast signature
-    verification' acceptance metric, isolated from square build and the
-    device pipeline."""
+def _make_pfb_node_and_txs(
+    n_tx: int, blob_bytes: int, seed: int, max_square: int, key_prefix: bytes
+):
+    """A funded TestNode plus n signed single-blob PFBs (shared by the
+    FilterTxs and PrepareProposal benches)."""
     from celestia_tpu.da.blob import Blob, BlobTx
     from celestia_tpu.da.inclusion import create_commitment
     from celestia_tpu.da.namespace import Namespace
@@ -182,17 +182,19 @@ def _filter_txs_ms(n_tx: int = 512):
     from celestia_tpu.state.tx import MsgPayForBlobs
     from celestia_tpu.utils.secp256k1 import PrivateKey
 
-    keys = [PrivateKey.from_seed(b"filt-%d" % i) for i in range(8)]
+    keys = [PrivateKey.from_seed(key_prefix + b"-%d" % i) for i in range(8)]
     node = TestNode(
         funded_accounts=[(key, 10**15) for key in keys], auto_produce=False
     )
-    node.app.params.set("blob", "GovMaxSquareSize", 128)
-    rng = np.random.default_rng(6)
+    node.app.params.set("blob", "GovMaxSquareSize", max_square)
+    rng = np.random.default_rng(seed)
     txs = []
     for i in range(n_tx):
         signer = Signer(node, keys[i % len(keys)])
         ns = Namespace.v0(bytes([i % 250 + 1]) * 10)
-        blob = Blob(ns, rng.integers(0, 256, 2000, dtype=np.uint8).tobytes())
+        blob = Blob(
+            ns, rng.integers(0, 256, blob_bytes, dtype=np.uint8).tobytes()
+        )
         msg = MsgPayForBlobs(
             signer=signer.address,
             namespaces=(ns.raw,),
@@ -204,8 +206,17 @@ def _filter_txs_ms(n_tx: int = 512):
             [msg], gas_limit=2_000_000, sequence=i // len(keys)
         )
         txs.append(BlobTx(tx.marshal(), [blob]).marshal())
+    return node, txs
+
+
+def _filter_txs_ms(n_tx: int = 512):
+    """FilterTxs (ante + native batch sig verify + commitment recompute)
+    over n signed single-blob PFBs — the VERDICT r1 #5 'fast signature
+    verification' acceptance metric, isolated from square build and the
+    device pipeline."""
     from celestia_tpu.da import inclusion
 
+    node, txs = _make_pfb_node_and_txs(n_tx, 2000, 6, 128, b"filt")
     times = []
     for _ in range(3):
         # measure the COLD commitment path: tx construction warmed the
@@ -220,42 +231,9 @@ def _filter_txs_ms(n_tx: int = 512):
 
 def _prepare_proposal_ms(k: int):
     """Full PrepareProposal over a square's worth of signed PFBs."""
-    from celestia_tpu.da.blob import Blob
-    from celestia_tpu.da.namespace import Namespace
-    from celestia_tpu.node.testnode import TestNode
-    from celestia_tpu.utils.secp256k1 import PrivateKey
-
     n_tx = max(2, k)  # ~k txs with blobs sized to fill a k x k square
     blob_bytes = max(478, (k * k * 478) // max(1, n_tx) - 4 * 478)
-    keys = [PrivateKey.from_seed(b"bench-%d" % i) for i in range(8)]
-    node = TestNode(
-        funded_accounts=[(key, 10**15) for key in keys], auto_produce=False
-    )
-    node.app.params.set("blob", "GovMaxSquareSize", k)
-    from celestia_tpu.client.signer import Signer
-
-    rng = np.random.default_rng(4)
-    txs = []
-    for i in range(n_tx):
-        signer = Signer(node, keys[i % len(keys)])
-        ns = Namespace.v0(bytes([i % 250 + 1]) * 10)
-        data = rng.integers(0, 256, blob_bytes, dtype=np.uint8).tobytes()
-        seq = i // len(keys)
-        from celestia_tpu.da.inclusion import create_commitment
-        from celestia_tpu.state.tx import MsgPayForBlobs
-
-        blob = Blob(ns, data)
-        msg = MsgPayForBlobs(
-            signer=signer.address,
-            namespaces=(ns.raw,),
-            blob_sizes=(len(data),),
-            share_commitments=(create_commitment(blob),),
-            share_versions=(0,),
-        )
-        tx = signer.sign_tx([msg], gas_limit=2_000_000, sequence=seq)
-        from celestia_tpu.da.blob import BlobTx
-
-        txs.append(BlobTx(tx.marshal(), [blob]).marshal())
+    node, txs = _make_pfb_node_and_txs(n_tx, blob_bytes, 4, k, b"bench")
     # warm device caches for this square size
     node.app.prepare_proposal(txs[:2])
     times = []

@@ -62,6 +62,7 @@ class ChannelKeeper:
         # outbound log relayers drain (packet-forward hops emit sends the
         # caller never sees, so the transport surfaces them here)
         self.sent: List[Tuple[Packet, int]] = []
+        self._timed_out: set = set()
 
     def open_channel(
         self, channel_id: str, counterparty_channel: str,
@@ -92,8 +93,30 @@ class ChannelKeeper:
     def write_ack(self, channel_id: str, seq: int, ack: Acknowledgement) -> None:
         self.acks[(channel_id, seq)] = ack
 
-    def delete_commitment(self, channel_id: str, seq: int) -> None:
-        self.commitments.pop((channel_id, seq), None)
+    def claim_commitment(self, channel_id: str, seq: int, data: bytes) -> None:
+        """Check-and-delete: the stored commitment must exist and match the
+        packet data (ibc-go's AcknowledgePacket/TimeoutPacket verify the
+        same before the app callback).  A missing commitment means the
+        packet's lifecycle already completed — acting on it again would
+        refund twice, so this RAISES instead of silently ignoring."""
+        key = (channel_id, seq)
+        stored = self.commitments.get(key)
+        if stored is None:
+            raise ValueError(
+                f"no commitment for packet {channel_id}#{seq}: already "
+                f"acked or timed out"
+            )
+        if stored != hashlib.sha256(data).digest():
+            raise ValueError(f"commitment mismatch for packet {channel_id}#{seq}")
+        del self.commitments[key]
+
+    # sequences whose timeout was processed: a late delivery must refuse
+    # (the source already refunded)
+    def mark_timed_out(self, channel_id: str, seq: int) -> None:
+        self._timed_out.add((channel_id, seq))
+
+    def is_timed_out(self, channel_id: str, seq: int) -> bool:
+        return (channel_id, seq) in self._timed_out
 
 
 class TransferModule:
@@ -113,7 +136,11 @@ class TransferModule:
         amount: int,
         denom: str,
         channel_id: str,
+        memo: str = "",
     ) -> Tuple[Packet, int]:
+        """memo rides inside the committed packet data (it carries
+        packet-forward instructions, so it MUST be covered by the
+        commitment — a relayer-injected memo would fail the claim)."""
         ch = self.channels.channels.get(channel_id)
         if ch is None:
             raise ValueError(f"unknown channel {channel_id}")
@@ -131,6 +158,7 @@ class TransferModule:
             amount=str(amount),
             sender=sender.hex(),
             receiver=receiver,
+            memo=memo,
         ).to_json()
         return self.channels.send_packet(channel_id, data)
 
@@ -167,10 +195,23 @@ class TransferModule:
     def on_acknowledgement(
         self, packet: Packet, seq: int, ack: Acknowledgement
     ) -> None:
-        self.channels.delete_commitment(packet.source_channel, seq)
+        # check-and-claim guards replay: a second ack (or ack-after-
+        # timeout) raises instead of refunding twice
+        self.channels.claim_commitment(packet.source_channel, seq, packet.data)
         if ack.success:
             return
-        # refund: reverse the send-side escrow/burn
+        self._refund(packet)
+
+    def on_timeout_packet(self, packet: Packet, seq: int) -> None:
+        """ICS-4 timeout: refund like an error ack (ibc-go's transfer
+        OnTimeoutPacket).  The commitment claim rejects timeout-after-ack,
+        double-timeout, and fabricated packets — the refund only ever
+        fires once per real in-flight send."""
+        self.channels.claim_commitment(packet.source_channel, seq, packet.data)
+        self._refund(packet)
+
+    def _refund(self, packet: Packet) -> None:
+        """Reverse the send-side escrow/burn."""
         try:
             data = FungibleTokenPacketData.from_json(packet.data)
         except (ValueError, KeyError):
@@ -407,7 +448,21 @@ class Relayer:
 
     def relay(self, src: IBCStack, packet: Packet, seq: int) -> Acknowledgement:
         dst = self.b if src is self.a else self.a
+        if dst.channels.is_timed_out(packet.dest_channel, seq):
+            # the source already refunded on timeout; executing the
+            # receive now would double-credit — refuse outright
+            raise ValueError(
+                f"packet {packet.dest_channel}#{seq} timed out; receive refused"
+            )
         ack = dst.on_recv_packet(packet)  # port-level router (ICA vs ICS-20)
         dst.channels.write_ack(packet.dest_channel, seq, ack)
         src.module.on_acknowledgement(packet, seq, ack)
         return ack
+
+    def timeout(self, src: IBCStack, packet: Packet, seq: int) -> None:
+        """Relayer processes a timeout: the destination marks the sequence
+        closed (a late delivery is refused from now on), then the source
+        refunds — once, enforced by the commitment claim."""
+        dst = self.b if src is self.a else self.a
+        dst.channels.mark_timed_out(packet.dest_channel, seq)
+        src.module.on_timeout_packet(packet, seq)

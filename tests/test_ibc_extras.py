@@ -52,17 +52,7 @@ def test_packet_forward_two_hops():
 
     memo = json.dumps({"forward": {"receiver": CAROL.hex(), "channel": "channel-1"}})
     packet, seq = a.module.send_transfer(
-        ALICE, "ignored-by-pfm", 250_000, "uosmo", "channel-0"
-    )
-    # rewrite packet data to carry the forward memo (send_transfer has no
-    # memo param on the src chain; the memo is consumed by the HUB)
-    data = FungibleTokenPacketData.from_json(packet.data)
-    packet = Packet(
-        packet.source_port, packet.source_channel,
-        packet.dest_port, packet.dest_channel,
-        FungibleTokenPacketData(
-            data.denom, data.amount, data.sender, data.receiver, memo
-        ).to_json(),
+        ALICE, "ignored-by-pfm", 250_000, "uosmo", "channel-0", memo=memo
     )
     ack = r_ab.relay(a, packet, seq)
     assert ack.success, ack.error
@@ -86,15 +76,7 @@ def test_forward_to_unknown_channel_error_acks():
     r_ab = Relayer(a, b, "channel-0", "channel-0")
     memo = json.dumps({"forward": {"receiver": CAROL.hex(), "channel": "channel-9"}})
     packet, seq = a.module.send_transfer(
-        ALICE, "x", 100_000, "uosmo", "channel-0"
-    )
-    data = FungibleTokenPacketData.from_json(packet.data)
-    packet = Packet(
-        packet.source_port, packet.source_channel,
-        packet.dest_port, packet.dest_channel,
-        FungibleTokenPacketData(
-            data.denom, data.amount, data.sender, data.receiver, memo
-        ).to_json(),
+        ALICE, "x", 100_000, "uosmo", "channel-0", memo=memo
     )
     ack = r_ab.relay(a, packet, seq)
     assert not ack.success and "forward failed" in ack.error
@@ -110,14 +92,8 @@ def test_forbidden_token_never_forwards_on_filtered_chain():
     r = Relayer(a, celestia, "channel-0", "channel-0")
     celestia.channels.open_channel("channel-1", "channel-0")
     memo = json.dumps({"forward": {"receiver": CAROL.hex(), "channel": "channel-1"}})
-    packet, seq = a.module.send_transfer(ALICE, "x", 50_000, "uosmo", "channel-0")
-    data = FungibleTokenPacketData.from_json(packet.data)
-    packet = Packet(
-        packet.source_port, packet.source_channel,
-        packet.dest_port, packet.dest_channel,
-        FungibleTokenPacketData(
-            data.denom, data.amount, data.sender, data.receiver, memo
-        ).to_json(),
+    packet, seq = a.module.send_transfer(
+        ALICE, "x", 50_000, "uosmo", "channel-0", memo=memo
     )
     ack = r.relay(a, packet, seq)
     assert not ack.success
@@ -211,14 +187,8 @@ def test_failed_forward_conserves_supply():
     b = _mk_chain("hub", False, [])
     r_ab = Relayer(a, b, "channel-0", "channel-0")
     memo = json.dumps({"forward": {"receiver": CAROL.hex(), "channel": "channel-9"}})
-    packet, seq = a.module.send_transfer(ALICE, "x", 100_000, "uosmo", "channel-0")
-    data = FungibleTokenPacketData.from_json(packet.data)
-    packet = Packet(
-        packet.source_port, packet.source_channel,
-        packet.dest_port, packet.dest_channel,
-        FungibleTokenPacketData(
-            data.denom, data.amount, data.sender, data.receiver, memo
-        ).to_json(),
+    packet, seq = a.module.send_transfer(
+        ALICE, "x", 100_000, "uosmo", "channel-0", memo=memo
     )
     ack = r_ab.relay(a, packet, seq)
     assert not ack.success
@@ -228,3 +198,60 @@ def test_failed_forward_conserves_supply():
     inter = forward_address("channel-9", CAROL.hex())
     voucher = "transfer/channel-0/uosmo"
     assert b.bank.balance_of(inter, voucher) == 0
+
+
+def test_timeout_refunds_sender():
+    """ICS-4 timeout: an undelivered transfer refunds exactly like an
+    error ack — escrowed tokens return, vouchers re-mint."""
+    a = _mk_chain("osmosis", False, [(ALICE, 100_000, "uosmo")])
+    b = _mk_chain("hub", False, [])
+    r = Relayer(a, b, "channel-0", "channel-0")
+    packet, seq = a.module.send_transfer(ALICE, "x", 60_000, "uosmo", "channel-0")
+    assert a.bank.balance_of(ALICE, "uosmo") == 40_000  # escrowed
+    assert (packet.source_channel, seq) in a.channels.commitments
+    r.timeout(a, packet, seq)
+    assert a.bank.balance_of(ALICE, "uosmo") == 100_000  # refunded
+    assert (packet.source_channel, seq) not in a.channels.commitments
+    # the hub never saw anything
+    assert not b.channels.acks
+
+
+def test_timeout_replay_and_late_delivery_rejected():
+    """Review findings: refund fires ONCE per in-flight packet — a second
+    timeout raises, an ack after timeout raises, and late delivery of a
+    timed-out packet is refused (the receiver must never mint what the
+    sender already got back)."""
+    a = _mk_chain("osmosis", False, [(ALICE, 100_000, "uosmo")])
+    b = _mk_chain("hub", False, [])
+    r = Relayer(a, b, "channel-0", "channel-0")
+    packet, seq = a.module.send_transfer(ALICE, "x", 60_000, "uosmo", "channel-0")
+    r.timeout(a, packet, seq)
+    assert a.bank.balance_of(ALICE, "uosmo") == 100_000
+    # double-timeout: the claim raises, no second refund
+    with pytest.raises(ValueError, match="already acked or timed out"):
+        r.timeout(a, packet, seq)
+    assert a.bank.balance_of(ALICE, "uosmo") == 100_000
+    # late delivery refused: no vouchers minted on the hub
+    with pytest.raises(ValueError, match="timed out; receive refused"):
+        r.relay(a, packet, seq)
+    assert b.bank.balance_of(b"x" * 20, "transfer/channel-0/uosmo") == 0
+
+
+def test_timeout_after_delivery_rejected():
+    """An already-delivered packet cannot be 'timed out' for a refund."""
+    a = _mk_chain("osmosis", False, [(ALICE, 100_000, "uosmo")])
+    b = _mk_chain("hub", False, [])
+    r = Relayer(a, b, "channel-0", "channel-0")
+    packet, seq = a.module.send_transfer(
+        ALICE, CAROL.hex(), 60_000, "uosmo", "channel-0"
+    )
+    ack = r.relay(a, packet, seq)
+    assert ack.success, ack.error
+    with pytest.raises(ValueError, match="already acked or timed out"):
+        r.timeout(a, packet, seq)
+    # escrow intact: the receiver's vouchers remain backed
+    from celestia_tpu.state.modules.ibc import escrow_address
+
+    assert a.bank.balance_of(
+        escrow_address("transfer", "channel-0"), "uosmo"
+    ) == 60_000

@@ -327,6 +327,7 @@ class PacketForwardMiddleware:
 
 
 ICA_HOST_PORT = "icahost"
+ICA_CONTROLLER_PORT = "icacontroller"
 
 
 def interchain_account_address(connection: str, owner: str) -> bytes:
@@ -335,6 +336,77 @@ def interchain_account_address(connection: str, owner: str) -> bytes:
     return hashlib.sha256(
         f"ics27-account/{connection}/{owner}".encode()
     ).digest()[:20]
+
+
+class ICAControllerModule:
+    """ICS-27 controller: drives interchain accounts on counterparty
+    hosts (the other half of ICAHostModule; the reference wires only the
+    host keeper, app/app.go:203 — the controller lives on the chains
+    whose users act THROUGH Celestia-hosted accounts, and is provided
+    here so two framework chains can pair up in tests and devnets)."""
+
+    def __init__(self, channels: ChannelKeeper):
+        self.channels = channels
+        # (channel, seq) -> Acknowledgement once the host answered
+        self.results: Dict[Tuple[str, int], Acknowledgement] = {}
+
+    def interchain_address(self, connection: str, owner: str) -> bytes:
+        """The account this owner controls on the host (same derivation)."""
+        return interchain_account_address(connection, owner)
+
+    def send_tx(
+        self,
+        owner: str,
+        connection: str,
+        channel_id: str,
+        msgs: List,
+    ) -> Tuple[Packet, int]:
+        """Package msgs into an ica_tx packet on an icacontroller channel.
+        Every msg must be signed-for by the owner's interchain account —
+        the host enforces it too, but failing early here saves a round
+        trip."""
+        from celestia_tpu.state.tx import marshal_msg
+
+        ch = self.channels.channels.get(channel_id)
+        if (
+            ch is None
+            or ch.port != ICA_CONTROLLER_PORT
+            or ch.state != "OPEN"
+        ):
+            raise ValueError(
+                f"{channel_id} is not an open {ICA_CONTROLLER_PORT} channel"
+            )
+        if not msgs:
+            # ibc-go's ICS-27 rejects empty tx data; a success ack for a
+            # no-op would mask the caller's empty-batch bug
+            raise ValueError("ica_tx needs at least one message")
+        ica = self.interchain_address(connection, owner)
+        for m in msgs:
+            if any(s != ica for s in m.signers()):
+                raise ValueError(
+                    "msg signer is not the owner's interchain account"
+                )
+        data = json.dumps(
+            {
+                "type": "ica_tx",
+                "owner": owner,
+                "connection": connection,
+                "msgs": [marshal_msg(m).hex() for m in msgs],
+            }
+        ).encode()
+        return self.channels.send_packet(channel_id, data)
+
+    def on_acknowledgement(
+        self, packet: Packet, seq: int, ack: Acknowledgement
+    ) -> None:
+        self.channels.claim_commitment(packet.source_channel, seq, packet.data)
+        self.results[(packet.source_channel, seq)] = ack
+
+    def on_timeout_packet(self, packet: Packet, seq: int) -> None:
+        self.channels.claim_commitment(packet.source_channel, seq, packet.data)
+        self.results[(packet.source_channel, seq)] = Acknowledgement(
+            False, "packet timed out"
+        )
 
 
 class ICAHostModule:
@@ -426,6 +498,7 @@ class IBCStack:
             module = PacketForwardMiddleware(module, transfer)
         self.module = module
         self.ica_host = ICAHostModule(self.app) if self.app is not None else None
+        self.ica_controller = ICAControllerModule(self.channels)
 
     def on_recv_packet(self, packet: Packet) -> Acknowledgement:
         """Port-level dispatch (IBC router role)."""
@@ -434,6 +507,12 @@ class IBCStack:
                 return Acknowledgement(False, "ICA host not enabled")
             return self.ica_host.on_recv_packet(packet)
         return self.module.on_recv_packet(packet)
+
+    def app_module_for(self, packet: Packet):
+        """The module owning a packet's SOURCE port (ack/timeout router)."""
+        if packet.source_port == ICA_CONTROLLER_PORT:
+            return self.ica_controller
+        return self.module
 
 
 class Relayer:
@@ -456,7 +535,7 @@ class Relayer:
             )
         ack = dst.on_recv_packet(packet)  # port-level router (ICA vs ICS-20)
         dst.channels.write_ack(packet.dest_channel, seq, ack)
-        src.module.on_acknowledgement(packet, seq, ack)
+        src.app_module_for(packet).on_acknowledgement(packet, seq, ack)
         return ack
 
     def timeout(self, src: IBCStack, packet: Packet, seq: int) -> None:
@@ -465,4 +544,4 @@ class Relayer:
         refunds — once, enforced by the commitment claim."""
         dst = self.b if src is self.a else self.a
         dst.channels.mark_timed_out(packet.dest_channel, seq)
-        src.module.on_timeout_packet(packet, seq)
+        src.app_module_for(packet).on_timeout_packet(packet, seq)

@@ -255,3 +255,106 @@ def test_timeout_after_delivery_rejected():
     assert a.bank.balance_of(
         escrow_address("transfer", "channel-0"), "uosmo"
     ) == 60_000
+
+
+def test_ica_controller_to_host_round_trip():
+    """Full ICS-27 pair: a controller chain registers an interchain
+    account, sends an ica_tx over its icacontroller channel, the host
+    executes it under the derived account, and the success ack lands back
+    on the controller."""
+    from celestia_tpu.state.modules.ibc import (
+        ICA_CONTROLLER_PORT,
+    )
+
+    controller = _mk_chain("osmosis", False, [])
+    app = App()
+    ica = interchain_account_address("connection-0", "osmo1owner")
+    app.init_chain({"accounts": [{"address": ica.hex(), "balance": 900_000}]})
+    host = app.ibc
+    relayer = Relayer(controller, host)
+    controller.channels.open_channel(
+        "channel-7", "channel-7",
+        port=ICA_CONTROLLER_PORT, counterparty_port=ICA_HOST_PORT,
+    )
+    host.channels.open_channel(
+        "channel-7", "channel-7",
+        port=ICA_HOST_PORT, counterparty_port=ICA_CONTROLLER_PORT,
+    )
+    dest = b"\x61" * 20
+    packet, seq = controller.ica_controller.send_tx(
+        "osmo1owner", "connection-0", "channel-7",
+        [MsgSend(ica, dest, 300_000)],
+    )
+    ack = relayer.relay(controller, packet, seq)
+    assert ack.success, ack.error
+    assert app.bank.balance(dest) == 300_000
+    # the controller recorded the host's answer, claim-once enforced
+    assert controller.ica_controller.results[("channel-7", seq)].success
+    with pytest.raises(ValueError, match="already acked or timed out"):
+        relayer.timeout(controller, packet, seq)
+
+
+def test_ica_controller_rejects_foreign_signer_early():
+    from celestia_tpu.state.modules.ibc import ICA_CONTROLLER_PORT
+
+    controller = _mk_chain("osmosis", False, [])
+    controller.channels.open_channel(
+        "channel-7", "channel-7",
+        port=ICA_CONTROLLER_PORT, counterparty_port=ICA_HOST_PORT,
+    )
+    victim = b"\x62" * 20
+    with pytest.raises(ValueError, match="not the owner's interchain account"):
+        controller.ica_controller.send_tx(
+            "osmo1owner", "connection-0", "channel-7",
+            [MsgSend(victim, b"\x63" * 20, 1)],
+        )
+
+
+def test_ica_controller_timeout_records_failure():
+    from celestia_tpu.state.modules.ibc import ICA_CONTROLLER_PORT
+
+    controller = _mk_chain("osmosis", False, [])
+    app = App()
+    ica = interchain_account_address("connection-0", "osmo1owner")
+    app.init_chain({"accounts": [{"address": ica.hex(), "balance": 1000}]})
+    host = app.ibc
+    relayer = Relayer(controller, host)
+    controller.channels.open_channel(
+        "channel-7", "channel-7",
+        port=ICA_CONTROLLER_PORT, counterparty_port=ICA_HOST_PORT,
+    )
+    host.channels.open_channel(
+        "channel-7", "channel-7",
+        port=ICA_HOST_PORT, counterparty_port=ICA_CONTROLLER_PORT,
+    )
+    packet, seq = controller.ica_controller.send_tx(
+        "osmo1owner", "connection-0", "channel-7",
+        [MsgSend(ica, b"\x64" * 20, 10)],
+    )
+    relayer.timeout(controller, packet, seq)
+    res = controller.ica_controller.results[("channel-7", seq)]
+    assert not res.success and "timed out" in res.error
+    # the host never executed
+    assert app.bank.balance(b"\x64" * 20) == 0
+
+
+def test_ica_controller_rejects_empty_and_closed(monkeypatch):
+    """Review findings: empty msg batches and CLOSED channels fail early."""
+    from celestia_tpu.state.modules.ibc import ICA_CONTROLLER_PORT
+
+    controller = _mk_chain("osmosis", False, [])
+    ch = controller.channels.open_channel(
+        "channel-7", "channel-7",
+        port=ICA_CONTROLLER_PORT, counterparty_port=ICA_HOST_PORT,
+    )
+    with pytest.raises(ValueError, match="at least one message"):
+        controller.ica_controller.send_tx(
+            "osmo1owner", "connection-0", "channel-7", []
+        )
+    ch.state = "CLOSED"
+    ica = interchain_account_address("connection-0", "osmo1owner")
+    with pytest.raises(ValueError, match="not an open"):
+        controller.ica_controller.send_tx(
+            "osmo1owner", "connection-0", "channel-7",
+            [MsgSend(ica, b"\x65" * 20, 1)],
+        )

@@ -371,10 +371,12 @@ class ICAControllerModule:
         if (
             ch is None
             or ch.port != ICA_CONTROLLER_PORT
+            or ch.counterparty_port != ICA_HOST_PORT
             or ch.state != "OPEN"
         ):
             raise ValueError(
-                f"{channel_id} is not an open {ICA_CONTROLLER_PORT} channel"
+                f"{channel_id} is not an open {ICA_CONTROLLER_PORT}->"
+                f"{ICA_HOST_PORT} channel"
             )
         if not msgs:
             # ibc-go's ICS-27 rejects empty tx data; a success ack for a

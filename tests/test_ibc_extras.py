@@ -338,7 +338,7 @@ def test_ica_controller_timeout_records_failure():
     assert app.bank.balance(b"\x64" * 20) == 0
 
 
-def test_ica_controller_rejects_empty_and_closed(monkeypatch):
+def test_ica_controller_rejects_empty_and_closed():
     """Review findings: empty msg batches and CLOSED channels fail early."""
     from celestia_tpu.state.modules.ibc import ICA_CONTROLLER_PORT
 

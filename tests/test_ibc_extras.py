@@ -358,3 +358,11 @@ def test_ica_controller_rejects_empty_and_closed():
             "osmo1owner", "connection-0", "channel-7",
             [MsgSend(ica, b"\x65" * 20, 1)],
         )
+    # wrong counterparty port (defaults to transfer): fail before the
+    # round trip, not with a late ICS-20 unmarshal ack
+    controller.channels.open_channel("channel-8", "x", port=ICA_CONTROLLER_PORT)
+    with pytest.raises(ValueError, match="not an open"):
+        controller.ica_controller.send_tx(
+            "osmo1owner", "connection-0", "channel-8",
+            [MsgSend(ica, b"\x65" * 20, 1)],
+        )

@@ -297,12 +297,22 @@ def blob_share_limit(ctx: AnteContext) -> None:
 
 def gov_param_filter(ctx: AnteContext) -> None:
     """GovProposalDecorator + x/paramfilter: hardfork-only params are
-    unchangeable by any governance path."""
+    unchangeable by any governance path, and a direct MsgParamChange is
+    NEVER acceptable in a user transaction — its only legitimate authority
+    is the gov module account, which holds no key and so cannot sign.
+    Param changes go through MsgSubmitProposal
+    (x/paramfilter/gov_handler.go:36-60)."""
+    from celestia_tpu.state.modules.gov import GOV_MODULE_ADDR
     from celestia_tpu.state.params import ParamBlockList
 
     block_list = ParamBlockList()
     for m in flat_msgs(ctx.tx):
         if isinstance(m, MsgParamChange):
+            if m.authority != GOV_MODULE_ADDR:
+                raise AnteError(
+                    "MsgParamChange may only be executed by the gov module "
+                    "account via a passed proposal"
+                )
             block_list.validate_change(m.subspace, m.key)
 
 

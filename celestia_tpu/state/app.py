@@ -547,6 +547,17 @@ class App:
             self.blobstream.register_evm_address(msg.validator, msg.evm_address)
             return {"type": "register_evm_address"}
         if isinstance(msg, MsgParamChange):
+            from celestia_tpu.state.modules.gov import GOV_MODULE_ADDR
+
+            # Only the gov module account may execute a param change — the
+            # reference routes ALL param changes through a passed proposal
+            # (x/paramfilter/gov_handler.go:36-60); a user-signed
+            # MsgParamChange must never write state.
+            if msg.authority != GOV_MODULE_ADDR:
+                raise ValueError(
+                    "param change authority must be the gov module account; "
+                    "submit a MsgSubmitProposal instead"
+                )
             self.param_block_list.validate_change(msg.subspace, msg.key)
             import json as _json
 

@@ -68,14 +68,17 @@ def distribution_solvency(app) -> Tuple[bool, str]:
 def gov_deposits_escrowed(app) -> Tuple[bool, str]:
     """Proposals still in voting keep their deposits escrowed in the gov
     pool (refunded on resolution, burned on veto)."""
-    from celestia_tpu.state.modules.gov import PROPOSAL_STATUS_VOTING
+    from celestia_tpu.state.modules.gov import (
+        GOV_MODULE_ADDR,
+        PROPOSAL_STATUS_VOTING,
+    )
 
     total = sum(
         p.deposit
         for p in app.gov.proposals()
         if p.status == PROPOSAL_STATUS_VOTING
     )
-    balance = app.bank.balance(b"gov-escrow-pool-addr")
+    balance = app.bank.balance(GOV_MODULE_ADDR)
     if balance < total:
         return False, f"gov escrow {balance} < active deposits {total}"
     return True, ""

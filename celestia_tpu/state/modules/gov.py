@@ -37,6 +37,13 @@ _PROPOSAL_PREFIX = b"proposal/"
 _VOTE_PREFIX = b"vote/"
 _NEXT_ID_KEY = b"next_proposal_id"
 
+# The gov module account: escrows deposits AND is the only authority allowed
+# to execute MsgParamChange.  It is a module address with no private key, so
+# no user transaction can ever carry a valid signature for it — param writes
+# happen exclusively through a passed proposal's execution.
+GOV_MODULE_ADDR = b"gov-escrow-pool-addr"
+assert len(GOV_MODULE_ADDR) == 20
+
 
 @dataclass
 class Proposal:
@@ -134,7 +141,7 @@ class GovKeeper:
         for subspace, key, _ in msg.changes:
             self.block_list.validate_change(subspace, key)
         # deposit escrows into the gov pool (burned on veto, else refunded)
-        self.bank.send(msg.proposer, b"gov-escrow-pool-addr", msg.deposit)
+        self.bank.send(msg.proposer, GOV_MODULE_ADDR, msg.deposit)
         pid = self._next_id()
         prop = Proposal(
             id=pid,
@@ -227,9 +234,9 @@ class GovKeeper:
             prop.status = PROPOSAL_STATUS_REJECTED
             prop.result_log = "threshold not reached"
         if burn_deposit:
-            self.bank.burn(b"gov-escrow-pool-addr", prop.deposit)
+            self.bank.burn(GOV_MODULE_ADDR, prop.deposit)
         else:
-            self.bank.send(b"gov-escrow-pool-addr", prop.proposer, prop.deposit)
+            self.bank.send(GOV_MODULE_ADDR, prop.proposer, prop.deposit)
         self._put(prop)
         return {
             "type": "proposal_tally",

@@ -143,7 +143,12 @@ class MsgUndelegate:
 
 @dataclass(frozen=True)
 class MsgParamChange:
-    """Governance parameter change; x/paramfilter blocks hardfork-only params."""
+    """Governance parameter change.  The executing authority MUST be the gov
+    module account (GOV_MODULE_ADDR) — params are only writable through a
+    passed proposal, never by a user-signed message
+    (x/paramfilter/gov_handler.go:36-60: the reference exposes param changes
+    exclusively through the gov proposal route).  x/paramfilter additionally
+    blocks hardfork-only params."""
 
     authority: bytes
     subspace: str

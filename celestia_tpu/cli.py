@@ -162,11 +162,45 @@ def cmd_start(args) -> int:
             int(json.loads(key_path.read_text())["priv_key"], 16)
         )
 
+    data_dir = str(Path(home) / "data")
     snapshot_dir = str(Path(home) / "data" / "snapshots")
     from celestia_tpu.node.snapshots import SnapshotStore
 
     latest_snap = SnapshotStore(snapshot_dir).latest()
-    if latest_snap is not None:
+    blocks_log = Path(data_dir) / "blocks.log"
+    node = None
+    if blocks_log.exists() and blocks_log.stat().st_size > 0:
+        # primary restart path: the append-only disk logs carry the whole
+        # chain to the last fsynced block (app.go:657-661 LoadLatestVersion
+        # role); snapshots below remain as the state-sync fallback
+        node = TestNode(
+            chain_id=genesis.get("chain_id", cfg.chain_id),
+            genesis=genesis,
+            validator_key=validator_key,
+            block_interval_ns=int(cfg.consensus.block_interval_s * 1e9),
+            auto_produce=False,
+            min_gas_price=cfg.min_gas_price,
+            v2_upgrade_height=cfg.v2_upgrade_height,
+            snapshot_dir=snapshot_dir,
+            snapshot_interval=cfg.snapshot.interval,
+            snapshot_keep_recent=cfg.snapshot.keep_recent,
+            data_dir=data_dir,
+        )
+        if node.blocks:
+            log.info(
+                "recovered chain from disk",
+                height=node.height,
+                app_hash=node.app.store.committed_hash(node.height).hex()[:16],
+            )
+        elif latest_snap is not None:
+            # the block log was fully torn; the snapshot is newer than a
+            # genesis reset, so prefer it
+            node = None
+        else:
+            log.info("block log unreadable; restarted from genesis")
+    if node is not None:
+        pass
+    elif latest_snap is not None:
         # restart path: resume from the latest state-sync snapshot instead
         # of silently resetting to genesis (root.go:227-243 restore wiring)
         node = TestNode.from_snapshot(
@@ -178,6 +212,7 @@ def cmd_start(args) -> int:
             validator_key=validator_key,
             min_gas_price=cfg.min_gas_price,
             v2_upgrade_height=cfg.v2_upgrade_height,
+            data_dir=data_dir,
         )
         log.info(
             "restored from snapshot",
@@ -196,6 +231,7 @@ def cmd_start(args) -> int:
             snapshot_dir=snapshot_dir,
             snapshot_interval=cfg.snapshot.interval,
             snapshot_keep_recent=cfg.snapshot.keep_recent,
+            data_dir=data_dir,
         )
     server = NodeServer(
         node,

@@ -74,6 +74,8 @@ class TestNode:
         snapshot_interval: int = 0,
         snapshot_keep_recent: int = 2,
         app: Optional[App] = None,
+        data_dir: Optional[str] = None,
+        state_checkpoint_interval: int = 500,
         **app_kwargs,
     ):
         # One reentrant lock serialises every client-surface entry point:
@@ -81,8 +83,67 @@ class TestNode:
         # the server's production loop all touch app/mempool/blocks state
         # (pkg/user's Signer is explicitly multi-threaded against one node)
         self._service_lock = threading.RLock()
+        # disk-backed persistence (data_dir given): recover a previous
+        # chain from the append-only logs, or start fresh and log from
+        # genesis.  The block log is the consistency anchor: a crash
+        # between the state fsync and the block fsync replays state only
+        # up to the last fully-persisted block.
+        self.data_dir = data_dir
+        self._state_log = None
+        self._block_log = None
+        recovered_blocks: List[Block] = []
+        disk_recovered = False
+        if data_dir and app is None:
+            import os as _os
+
+            from celestia_tpu.state.disk import BlockLog, StateLog
+
+            recovered_blocks = BlockLog.recover(data_dir)
+            if recovered_blocks:
+                rec = StateLog.recover(
+                    data_dir, up_to=recovered_blocks[-1].header.height
+                )
+                if rec is None:
+                    raise RuntimeError(
+                        f"data dir {data_dir} has blocks but no intact "
+                        "state log"
+                    )
+                state, h, ah = rec
+                if h != recovered_blocks[-1].header.height:
+                    raise RuntimeError(
+                        f"state log recovered to height {h} but block log "
+                        f"ends at {recovered_blocks[-1].header.height}"
+                    )
+                app = App.restore_from_disk(state, h, ah, **app_kwargs)
+                disk_recovered = True
+            else:
+                # no fully-persisted block survived: a stale state.log
+                # (e.g. crash in the first block's fsync window) would
+                # poison a fresh chain with duplicate/orphan records —
+                # start from a clean slate
+                for name in ("state.log", "blocks.log"):
+                    p = _os.path.join(data_dir, name)
+                    if _os.path.exists(p):
+                        _os.remove(p)
         restored = app is not None
         self.app = app if restored else App(chain_id=chain_id, **app_kwargs)
+        if data_dir:
+            from celestia_tpu.state.disk import BlockLog, StateLog
+
+            self._state_log = StateLog(
+                data_dir, checkpoint_interval=state_checkpoint_interval
+            )
+            self._block_log = BlockLog(data_dir)
+            if restored and not disk_recovered:
+                # snapshot-restored app adopting a data dir: seed the
+                # state log with a base checkpoint so future recoveries
+                # replay from here, not from an empty state
+                self._state_log.append_checkpoint(
+                    self.app.store.last_height,
+                    self.app.store.committed_hash(self.app.store.last_height),
+                    self.app.store.raw_state(),
+                )
+            self.app.store.set_persister(self._persist_commit)
         self.chain_id = self.app.chain_id if restored else chain_id
         self.block_interval_ns = block_interval_ns
         self.auto_produce = auto_produce
@@ -108,6 +169,18 @@ class TestNode:
         self._validator_key = validator_key or PrivateKey.from_seed(
             b"testnode-validator"
         )
+        if recovered_blocks:
+            # disk recovery: resume the chain where the logs end
+            self.blocks = recovered_blocks
+            for blk in recovered_blocks:
+                for raw, res in zip(blk.txs, blk.tx_results):
+                    self._tx_index[hashlib.sha256(raw).digest()] = {
+                        "code": res.code,
+                        "log": res.log,
+                        "height": blk.header.height,
+                    }
+            self._now_ns = recovered_blocks[-1].header.time_ns
+            return
         if restored:
             # state-sync restore: the app already carries committed state at
             # its snapshot height; no InitChain
@@ -141,6 +214,29 @@ class TestNode:
                 genesis["genesis_time_ns"] = genesis_time_ns or _time.time_ns()
         self.app.init_chain(genesis)
         self._now_ns = self.app.genesis_time_ns
+
+    # ------------------------------------------------------------------
+    # persistence
+    # ------------------------------------------------------------------
+
+    def _persist_commit(self, height, app_hash, roots, forward) -> None:
+        self._state_log.append_commit(
+            height,
+            app_hash,
+            roots,
+            forward,
+            full_state_fn=self.app.store.raw_state,
+        )
+
+    def close(self) -> None:
+        """Release the disk logs (restart tests reopen the data dir)."""
+        if self._state_log is not None:
+            self._state_log.close()
+            self._state_log = None
+        if self._block_log is not None:
+            self._block_log.close()
+            self._block_log = None
+        self.app.store.set_persister(None)
 
     # ------------------------------------------------------------------
     # client surface (what pkg/user's gRPC connection provides)
@@ -273,6 +369,8 @@ class TestNode:
         )
         block = Block(header, list(block_txs), results, proposer, votes)
         self.blocks.append(block)
+        if self._block_log is not None:
+            self._block_log.append_block(block)
         # retain the proposal's EDS + layout for proof queries (bounded);
         # non-proposers reconstruct on demand via _block_artifacts
         if artifacts is not None:
@@ -367,11 +465,14 @@ class TestNode:
         snapshot_interval: int = 0,
         snapshot_keep_recent: int = 2,
         validator_key: Optional[PrivateKey] = None,
+        data_dir: Optional[str] = None,
         **app_kwargs,
     ) -> "TestNode":
         """Boot a node from the latest state-sync snapshot (the restart
         path of the reference's snapshot subsystem).  Snapshotting keeps
-        running at the given interval after restore."""
+        running at the given interval after restore.  With ``data_dir``
+        the restored node also logs every block to disk from here on
+        (seeded with a base checkpoint at the snapshot height)."""
         from celestia_tpu.node.snapshots import SnapshotStore
 
         store = SnapshotStore(snapshot_dir)
@@ -387,6 +488,7 @@ class TestNode:
             snapshot_interval=snapshot_interval,
             snapshot_keep_recent=snapshot_keep_recent,
             validator_key=validator_key,
+            data_dir=data_dir,
         )
 
     def produce_blocks(self, n: int) -> List[Block]:
@@ -442,7 +544,25 @@ class TestNode:
         from celestia_tpu.da.blob import unmarshal_blob_tx as _ubt
 
         if path == "store/bank/balance":
-            return self.app.bank.balance(bytes.fromhex(data["address"]))
+            addr = bytes.fromhex(data["address"])
+            if data.get("height"):
+                # height-pinned read against the committed version window
+                from celestia_tpu.state.bank import BankKeeper
+
+                raw = self.app.store.get_at(
+                    "bank", BankKeeper.balance_key(addr), int(data["height"])
+                )
+                return int.from_bytes(raw, "big") if raw else 0
+            return self.app.bank.balance(addr)
+        if path == "store/proof":
+            # generic merkleized-state query: any (store, key) at a pinned
+            # height, with the membership proof a client verifies against
+            # that block's app hash (state.merkle.verify_query_proof) —
+            # the reference's `--prove` ABCI query over IAVL
+            height = int(data["height"]) if data.get("height") else None
+            return self.app.store.prove(
+                data["store"], bytes.fromhex(data["key"]), height
+            )
         if path == "custom/auth/account":
             acc = self.app.accounts.peek(bytes.fromhex(data["address"]))
             return {

@@ -209,6 +209,12 @@ class App:
         self.genesis_time_ns = genesis.get(
             "genesis_time_ns", _time.time_ns()
         )
+        # persisted in-store so a disk-recovered node needs no side channel
+        # (identical across validators -> app-hash safe)
+        self.store.store("meta").set(
+            b"genesis_time_ns", self.genesis_time_ns.to_bytes(8, "big")
+        )
+        self.store.store("meta").set(b"chain_id", self.chain_id.encode())
         self.mint.init_genesis(self.genesis_time_ns)
         for acc in genesis.get("accounts", []):
             addr = bytes.fromhex(acc["address"])
@@ -787,4 +793,37 @@ class App:
                 f"{got.hex()}, snapshot recorded {expected_app_hash.hex()}"
             )
         app.store.commit_at(height, got)
+        return app
+
+    @classmethod
+    def restore_from_disk(
+        cls,
+        state: "Dict[str, Dict[bytes, bytes]]",
+        height: int,
+        expected_app_hash: bytes,
+        **kwargs,
+    ) -> "App":
+        """Rebuild an App from a recovered state.log (state.disk), the
+        LoadLatestVersion role of app/app.go:657-661.  The replayed state
+        must reproduce the last committed app hash or recovery refuses."""
+        app = cls(**kwargs)
+        app.store = MultiStore.from_raw(state)
+        for name in STORE_NAMES:
+            app.store.ensure_store(name)
+        # identity first: _wire_keepers bakes chain_id into the IBC stack
+        meta = app.store.store("meta")
+        raw_ts = meta.get(b"genesis_time_ns")
+        app.genesis_time_ns = int.from_bytes(raw_ts, "big") if raw_ts else 0
+        raw_cid = meta.get(b"chain_id")
+        if raw_cid:
+            app.chain_id = raw_cid.decode()
+        app._wire_keepers()
+        got = app.store.app_hash()
+        if got != expected_app_hash:
+            raise ValueError(
+                f"disk recovery hash mismatch: replayed state hashes to "
+                f"{got.hex()}, log recorded {expected_app_hash.hex()}"
+            )
+        app.store.commit_at(height, got)
+        app.block_height = height
         return app

@@ -43,6 +43,12 @@ class BankKeeper:
     def __init__(self, store: KVStore):
         self.store = store
 
+    @staticmethod
+    def balance_key(addr: bytes) -> bytes:
+        """The raw store key for an account balance — what a light client
+        asks the `store/proof` query route to prove."""
+        return _BALANCE_PREFIX + addr
+
     def balance(self, addr: bytes) -> int:
         raw = self.store.get(_BALANCE_PREFIX + addr)
         return int.from_bytes(raw, "big") if raw else 0

@@ -30,3 +30,9 @@ jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, (
     f"tests expect 8 virtual CPU devices, got {jax.devices()}"
 )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running process-level e2e tests"
+    )

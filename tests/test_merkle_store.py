@@ -1,0 +1,301 @@
+"""Incremental merkle multistore: SMT invariants, commit cost shape,
+height-pinned reads and client-verifiable proofs.
+
+VERDICT r2 next-round #3: replace the flatten-and-rehash app hash with a
+per-store merkle tree maintained incrementally; serve Query at a pinned
+height with a membership proof a client verifies against the block's app
+hash.  Reference role: IAVL at /root/reference/app/app.go:242.
+"""
+
+import hashlib
+import random
+
+import pytest
+
+from celestia_tpu.state import merkle
+from celestia_tpu.state.merkle import (
+    EMPTY_ROOT,
+    smt_build,
+    smt_delete,
+    smt_get,
+    smt_prove,
+    smt_reachable,
+    smt_update,
+    verify_membership,
+    verify_non_membership,
+    verify_query_proof,
+)
+from celestia_tpu.state.store import MultiStore
+
+
+def _kh(i):
+    return merkle.key_hash(f"key-{i}".encode())
+
+
+def _vh(i):
+    return merkle.value_hash(f"val-{i}".encode())
+
+
+# --- pure SMT ---------------------------------------------------------------
+
+
+def test_smt_insert_get_delete_roundtrip():
+    nodes = {}
+    root = EMPTY_ROOT
+    for i in range(200):
+        root = smt_update(nodes, root, _kh(i), _vh(i))
+    for i in range(200):
+        assert smt_get(nodes, root, _kh(i)) == _vh(i)
+    assert smt_get(nodes, root, _kh(999)) is None
+    for i in range(0, 200, 2):
+        root = smt_delete(nodes, root, _kh(i))
+    for i in range(200):
+        expect = None if i % 2 == 0 else _vh(i)
+        assert smt_get(nodes, root, _kh(i)) == expect
+
+
+def test_smt_root_is_order_independent():
+    """The compact tree is canonical: any insert order, with any
+    interleaved overwrites and deletes, yields the same root."""
+    items = [(_kh(i), _vh(i)) for i in range(64)]
+    roots = set()
+    for seed in range(4):
+        rng = random.Random(seed)
+        shuffled = items[:]
+        rng.shuffle(shuffled)
+        nodes = {}
+        root = EMPTY_ROOT
+        for kh, vh in shuffled:
+            # noise write + delete that must not affect the final root
+            root = smt_update(nodes, root, _kh(1000), _vh(0))
+            root = smt_update(nodes, root, kh, _vh(0))
+            root = smt_update(nodes, root, kh, vh)
+            root = smt_delete(nodes, root, _kh(1000))
+        roots.add(root)
+    assert len(roots) == 1
+
+
+def test_smt_delete_everything_returns_empty():
+    nodes = {}
+    root = smt_build(nodes, [(_kh(i), _vh(i)) for i in range(33)])
+    for i in range(33):
+        root = smt_delete(nodes, root, _kh(i))
+    assert root == EMPTY_ROOT
+
+
+def test_smt_membership_and_non_membership_proofs():
+    nodes = {}
+    keys = [f"key-{i}".encode() for i in range(50)]
+    root = smt_build(
+        nodes,
+        [(merkle.key_hash(k), merkle.value_hash(b"v" + k)) for k in keys],
+    )
+    for k in keys[:10]:
+        sib, leaf = smt_prove(nodes, root, merkle.key_hash(k))
+        assert verify_membership(root, k, b"v" + k, sib, leaf)
+        assert not verify_membership(root, k, b"wrong", sib, leaf)
+        assert not verify_non_membership(root, k, sib, leaf)
+    for k in [b"absent-1", b"absent-2", b"absent-3"]:
+        sib, leaf = smt_prove(nodes, root, merkle.key_hash(k))
+        assert verify_non_membership(root, k, sib, leaf)
+        assert not verify_membership(root, k, b"anything", sib, leaf)
+
+
+def test_smt_proof_rejects_forged_value_and_root():
+    nodes = {}
+    root = smt_build(nodes, [(_kh(i), _vh(i)) for i in range(20)])
+    k = b"key-3"
+    sib, leaf = smt_prove(nodes, root, merkle.key_hash(k))
+    assert verify_membership(root, k, b"val-3", sib, leaf)
+    # forged sibling path
+    bad = list(sib)
+    if bad:
+        bad[0] = hashlib.sha256(b"forged").digest()
+        assert not verify_membership(root, k, b"val-3", bad, leaf)
+    # proof against a different root
+    other_root = smt_build({}, [(_kh(i), _vh(i)) for i in range(21)])
+    assert not verify_membership(other_root, k, b"val-3", sib, leaf)
+
+
+def test_smt_old_roots_stay_readable_and_gc_drops_garbage():
+    nodes = {}
+    r1 = smt_build(nodes, [(_kh(i), _vh(i)) for i in range(32)])
+    r2 = smt_update(nodes, r1, _kh(0), _vh(999))
+    # both versions readable (content-addressed persistence)
+    assert smt_get(nodes, r1, _kh(0)) == _vh(0)
+    assert smt_get(nodes, r2, _kh(0)) == _vh(999)
+    live = smt_reachable(nodes, [r2])
+    assert len(live) < len(nodes)
+    nodes2 = {h: e for h, e in nodes.items() if h in live}
+    assert smt_get(nodes2, r2, _kh(5)) == _vh(5)
+
+
+# --- MultiStore integration -------------------------------------------------
+
+
+def test_multistore_commit_and_rollback():
+    ms = MultiStore(["a", "b"])
+    ms.store("a").set(b"k", b"v1")
+    h1 = ms.commit(1)
+    ms.store("a").set(b"k", b"v2")
+    ms.store("b").set(b"x", b"y")
+    h2 = ms.commit(2)
+    assert h1 != h2
+    ms.load_height(1)
+    assert ms.store("a").get(b"k") == b"v1"
+    assert ms.store("b").get(b"x") is None
+    assert ms.app_hash() == h1
+    # identical state -> identical hash (validator determinism)
+    ms2 = MultiStore(["a", "b"])
+    ms2.store("a").set(b"k", b"v1")
+    assert ms2.commit(1) == h1
+
+
+def test_incremental_hash_equals_from_scratch():
+    """The incremental commit path must agree with a fresh full build of
+    the same final state — including after deletes and overwrites."""
+    ms = MultiStore(["a", "b"])
+    rng = random.Random(7)
+    final = {"a": {}, "b": {}}
+    for height in range(1, 21):
+        for _ in range(30):
+            name = rng.choice(["a", "b"])
+            k = f"k{rng.randrange(100)}".encode()
+            if rng.random() < 0.2:
+                ms.store(name).delete(k)
+                final[name].pop(k, None)
+            else:
+                v = f"v{height}-{rng.randrange(1000)}".encode()
+                ms.store(name).set(k, v)
+                final[name][k] = v
+        ms.commit(height)
+    fresh = MultiStore(["a", "b"])
+    for name, d in final.items():
+        for k, v in d.items():
+            fresh.store(name).set(k, v)
+    assert fresh.commit(1) == ms.committed_hash(20)
+
+
+def test_pinned_height_reads():
+    ms = MultiStore(["bank"])
+    ms.store("bank").set(b"alice", b"100")
+    ms.commit(1)
+    ms.store("bank").set(b"alice", b"60")
+    ms.store("bank").set(b"bob", b"40")
+    ms.commit(2)
+    ms.store("bank").delete(b"alice")
+    ms.commit(3)
+    # uncommitted write must not leak into pinned reads
+    ms.store("bank").set(b"alice", b"uncommitted")
+    assert ms.get_at("bank", b"alice", 1) == b"100"
+    assert ms.get_at("bank", b"alice", 2) == b"60"
+    assert ms.get_at("bank", b"alice", 3) is None
+    assert ms.get_at("bank", b"bob", 1) is None
+    assert ms.get_at("bank", b"bob", 3) == b"40"
+
+
+def test_query_proof_verifies_against_app_hash():
+    ms = MultiStore(["bank", "params"])
+    ms.store("bank").set(b"alice", b"100")
+    ms.store("params").set(b"minfee", b"1")
+    h1 = ms.commit(1)
+    ms.store("bank").set(b"alice", b"250")
+    h2 = ms.commit(2)
+    # membership at both heights, against each height's app hash
+    p1 = ms.prove("bank", b"alice", height=1)
+    assert p1["value"] == b"100".hex()
+    assert verify_query_proof(p1, h1)
+    assert not verify_query_proof(p1, h2)
+    p2 = ms.prove("bank", b"alice", height=2)
+    assert p2["value"] == b"250".hex()
+    assert verify_query_proof(p2, h2)
+    # non-membership proof
+    p3 = ms.prove("bank", b"mallory", height=2)
+    assert p3["value"] is None
+    assert verify_query_proof(p3, h2)
+    # a tampered value fails
+    p2["value"] = b"999".hex()
+    assert not verify_query_proof(p2, h2)
+
+
+def test_commit_touches_only_written_keys():
+    """Commit work is proportional to the write set: untouched keys'
+    merkle leaves are not rebuilt (their node encodings are reused)."""
+    ms = MultiStore(["a"])
+    for i in range(500):
+        ms.store("a").set(f"k{i}".encode(), f"v{i}".encode())
+    ms.commit(1)
+    nodes_before = len(ms._nodes)
+    ms.store("a").set(b"k0", b"changed")
+    ms.commit(2)
+    # one leaf path rebuilt: O(log N) new nodes, not O(N)
+    assert len(ms._nodes) - nodes_before < 40
+
+
+def test_history_window_bounds_memory():
+    ms = MultiStore(["a"], history_keep=8)
+    for h in range(1, 101):
+        ms.store("a").set(b"counter", str(h).encode())
+        ms.commit(h)
+    assert len(ms._meta) == 8
+    assert len(ms._reverse_diffs) == 8
+    assert ms.get_at("a", b"counter", 100) == b"100"
+    assert ms.get_at("a", b"counter", 93) == b"93"
+    with pytest.raises(KeyError):
+        ms.get_at("a", b"counter", 10)
+    with pytest.raises(KeyError):
+        ms.load_height(10)
+
+
+def test_branch_isolation_and_writeback_dirty_tracking():
+    ms = MultiStore(["a"])
+    ms.store("a").set(b"k", b"v")
+    ms.commit(1)
+    br = ms.branch()
+    br.store("a").set(b"k", b"changed")
+    br.store("a").set(b"new", b"n")
+    assert ms.store("a").get(b"k") == b"v"
+    h_before = ms.app_hash()
+    ms.write_back(br)
+    assert ms.store("a").get(b"k") == b"changed"
+    h2 = ms.commit(2)
+    assert h2 != h_before
+    ms.load_height(1)
+    assert ms.store("a").get(b"k") == b"v"
+    assert ms.store("a").get(b"new") is None
+    assert ms.app_hash() == h_before
+
+
+def test_export_import_preserves_hash():
+    ms = MultiStore(["a"])
+    ms.store("a").set(b"bin\x00key", b"\xff\xfe")
+    ms.commit(1)
+    dump = ms.export()
+    ms2 = MultiStore.import_state(dump)
+    assert ms2.store("a").get(b"bin\x00key") == b"\xff\xfe"
+    assert ms2.app_hash() == ms.app_hash()
+
+
+def test_apply_diff_replay_matches_original():
+    """Forward diffs captured by the persister replay to the same state
+    and app hash (the disk-log recovery invariant)."""
+    records = []
+    ms = MultiStore(["a", "b"])
+    ms.set_persister(
+        lambda h, ah, roots, fwd: records.append((h, ah, fwd))
+    )
+    rng = random.Random(3)
+    for height in range(1, 11):
+        for _ in range(20):
+            name = rng.choice(["a", "b"])
+            k = f"k{rng.randrange(40)}".encode()
+            if rng.random() < 0.25:
+                ms.store(name).delete(k)
+            else:
+                ms.store(name).set(k, f"v{height}".encode())
+        ms.commit(height)
+    replay = MultiStore(["a", "b"])
+    for h, ah, fwd in records:
+        replay.apply_diff(fwd)
+        assert replay.commit(h) == ah
+    assert replay.export() == ms.export()

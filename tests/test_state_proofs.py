@@ -1,0 +1,134 @@
+"""Height-pinned state queries with client-verified merkle proofs, over
+the real gRPC boundary.
+
+VERDICT r2 next-round #3 "done" criterion: a balance query proof verifies
+client-side against the block's app hash; a tampered proof fails.
+Reference: the `--prove` ABCI query over the IAVL multistore
+(/root/reference/app/app.go:242).
+"""
+
+import numpy as np
+import pytest
+
+from celestia_tpu.client.remote import RemoteNode
+from celestia_tpu.client.signer import Signer
+from celestia_tpu.node.server import NodeServer
+from celestia_tpu.node.testnode import TestNode
+from celestia_tpu.state.bank import BankKeeper
+from celestia_tpu.state.merkle import verify_query_proof
+from celestia_tpu.state.tx import MsgSend
+from celestia_tpu.utils.secp256k1 import PrivateKey
+
+
+@pytest.fixture(scope="module")
+def served_node():
+    alice = PrivateKey.from_seed(b"proof-alice")
+    node = TestNode(
+        funded_accounts=[(alice, 10**12)],
+        auto_produce=True,
+        block_interval_ns=10**9,
+    )
+    from celestia_tpu.da import dah as dah_mod
+
+    for k in (1, 2):
+        dah_mod.extend_and_header(np.zeros((k, k, 512), dtype=np.uint8))
+    with NodeServer(node, block_interval_s=None) as server:
+        remote = RemoteNode(server.address, timeout_s=120.0)
+        yield node, remote, alice
+        remote.close()
+
+
+def _trusted_app_hash(remote, height):
+    return bytes.fromhex(remote.block(height)["app_hash"])
+
+
+def test_balance_proof_verifies_against_header(served_node):
+    node, remote, alice = served_node
+    signer = Signer(remote, alice)
+    bob = b"\x42" * 20
+    res = signer.submit_tx([MsgSend(signer.address, bob, 12_345)])
+    assert res.code == 0, res.log
+    height = node.height
+    key = BankKeeper.balance_key(bob)
+    proof = remote.abci_query(
+        "store/proof", {"store": "bank", "key": key.hex(), "height": height}
+    )
+    assert int.from_bytes(bytes.fromhex(proof["value"]), "big") == 12_345
+    # the client checks the proof against the app hash in the header it
+    # trusts — NOT against anything the query returned
+    assert verify_query_proof(proof, _trusted_app_hash(remote, height))
+
+
+def test_pinned_height_sees_historical_balance(served_node):
+    node, remote, alice = served_node
+    signer = Signer(remote, alice)
+    carol = b"\x43" * 20
+    res = signer.submit_tx([MsgSend(signer.address, carol, 1_000)])
+    assert res.code == 0, res.log
+    h1 = node.height
+    res = signer.submit_tx([MsgSend(signer.address, carol, 2_000)])
+    assert res.code == 0, res.log
+    h2 = node.height
+    assert h2 > h1
+    bal_h1 = remote.abci_query(
+        "store/bank/balance", {"address": carol.hex(), "height": h1}
+    )
+    bal_h2 = remote.abci_query(
+        "store/bank/balance", {"address": carol.hex(), "height": h2}
+    )
+    assert bal_h1 == 1_000
+    assert bal_h2 == 3_000
+    # each height's proof verifies only against its own header
+    key = BankKeeper.balance_key(carol)
+    p1 = remote.abci_query(
+        "store/proof", {"store": "bank", "key": key.hex(), "height": h1}
+    )
+    assert verify_query_proof(p1, _trusted_app_hash(remote, h1))
+    assert not verify_query_proof(p1, _trusted_app_hash(remote, h2))
+
+
+def test_absence_proof(served_node):
+    node, remote, _ = served_node
+    height = node.height
+    ghost = BankKeeper.balance_key(b"\x66" * 20)
+    proof = remote.abci_query(
+        "store/proof", {"store": "bank", "key": ghost.hex(), "height": height}
+    )
+    assert proof["value"] is None
+    assert verify_query_proof(proof, _trusted_app_hash(remote, height))
+
+
+def test_tampered_proof_rejected(served_node):
+    node, remote, alice = served_node
+    height = node.height
+    key = BankKeeper.balance_key(alice.public_key().address())
+    proof = remote.abci_query(
+        "store/proof", {"store": "bank", "key": key.hex(), "height": height}
+    )
+    ah = _trusted_app_hash(remote, height)
+    assert verify_query_proof(proof, ah)
+    # a lying server inflates the value
+    forged = dict(proof)
+    forged["value"] = (10**18).to_bytes(16, "big").hex()
+    assert not verify_query_proof(forged, ah)
+    # ... or swaps in consistent-but-different store roots
+    forged2 = dict(proof)
+    forged2["store_roots"] = dict(proof["store_roots"])
+    forged2["store_roots"]["bank"] = "11" * 32
+    assert not verify_query_proof(forged2, ah)
+
+
+def test_param_proof(served_node):
+    """Any store is provable — e.g. the governance-set min gas price."""
+    node, remote, _ = served_node
+    height = node.height
+    import json as _json
+
+    key = b"minfee/NetworkMinGasPricePpm"
+    proof = remote.abci_query(
+        "store/proof",
+        {"store": "params", "key": key.hex(), "height": height},
+    )
+    assert proof["value"] is not None
+    assert verify_query_proof(proof, _trusted_app_hash(remote, height))
+    assert _json.loads(bytes.fromhex(proof["value"])) > 0

@@ -386,6 +386,23 @@ def cmd_query(args) -> int:
     elif args.query_cmd == "tx":
         info = node.get_tx(bytes.fromhex(args.hash))
         print(json.dumps(info if info else {"found": False}))
+    elif args.query_cmd == "txs":
+        value = node.abci_query("custom/tx/search", {"event": args.event})
+        print(json.dumps(value))
+    elif args.query_cmd == "state-proof":
+        # fetch + VERIFY a (store, key) membership proof against the
+        # block header's app hash, like a light client would
+        from celestia_tpu.state.merkle import verify_query_proof
+
+        data = {"store": args.store, "key": args.key}
+        if args.height:
+            data["height"] = args.height
+        proof = node.abci_query("store/proof", data)
+        trusted = bytes.fromhex(node.block(proof["height"])["app_hash"])
+        ok = verify_query_proof(proof, trusted)
+        print(json.dumps({"verified": ok, **proof}))
+        if not ok:
+            return 1
     elif args.query_cmd == "block":
         print(json.dumps(node.block(int(args.height))))
     elif args.query_cmd == "param":
@@ -715,6 +732,13 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("address")
     q = qs.add_parser("tx")
     q.add_argument("hash")
+    q = qs.add_parser("txs", help="search txs by indexed event")
+    q.add_argument("--event", required=True,
+                   help='e.g. "transfer" or "transfer.recipient=<hex>"')
+    q = qs.add_parser("state-proof", help="verified state query")
+    q.add_argument("store")
+    q.add_argument("key", help="raw store key, hex")
+    q.add_argument("--height", type=int, default=0)
     q = qs.add_parser("block")
     q.add_argument("height")
     q = qs.add_parser("param")

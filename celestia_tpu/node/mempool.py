@@ -61,6 +61,25 @@ class Mempool:
         )
         return ordered if max_txs is None else ordered[:max_txs]
 
+    def recheck(self, still_valid) -> int:
+        """Comet recheck parity: after a block commits, every pooled tx
+        re-runs CheckTx against the fresh state; invalidated txs (spent
+        balance, consumed sequence, expired timeout) leave the pool
+        immediately instead of lingering until TTL.  Iterates in
+        ADMISSION order — not reap order — because a same-account
+        sequence chain was admitted oldest-nonce-first regardless of gas
+        price, and rechecking a later nonce before an earlier one would
+        wrongly evict a still-valid chain.
+        still_valid(raw) -> bool; returns the eviction count."""
+        evicted = 0
+        for t in sorted(
+            list(self._txs.values()), key=lambda t: self._order[t.tx_hash]
+        ):
+            if not still_valid(t.raw):
+                self.remove(t.tx_hash)
+                evicted += 1
+        return evicted
+
     def evict_expired(self, current_height: int) -> int:
         expired = [
             h

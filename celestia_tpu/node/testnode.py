@@ -161,6 +161,10 @@ class TestNode:
         self.mempool = Mempool(max_tx_bytes=max_bytes)
         self.blocks: List[Block] = []
         self._tx_index: Dict[bytes, dict] = {}
+        # event index: "type" and "type.attr=value" -> tx hashes, serving
+        # query-by-event (the reference's tx_search over indexed events,
+        # pkg/user/signer.go:365-395 confirm workflows)
+        self._event_index: Dict[str, List[bytes]] = {}
         # recent-block EDS/DAH/layout cache: inclusion proofs are served
         # from here without recomputing the extension (the role of
         # pkg/inclusion's EDS subtree cache + pkg/proof query routes)
@@ -174,11 +178,9 @@ class TestNode:
             self.blocks = recovered_blocks
             for blk in recovered_blocks:
                 for raw, res in zip(blk.txs, blk.tx_results):
-                    self._tx_index[hashlib.sha256(raw).digest()] = {
-                        "code": res.code,
-                        "log": res.log,
-                        "height": blk.header.height,
-                    }
+                    self._index_tx(
+                        hashlib.sha256(raw).digest(), res, blk.header.height
+                    )
             self._now_ns = recovered_blocks[-1].header.time_ns
             return
         if restored:
@@ -218,6 +220,30 @@ class TestNode:
     # ------------------------------------------------------------------
     # persistence
     # ------------------------------------------------------------------
+
+    def _index_tx(self, tx_hash: bytes, res, height: int) -> None:
+        """Record a delivered tx in the hash index and the event index.
+        res.events must already be JSON-safe (normalized in _apply_block
+        and by block-log recovery)."""
+        self._tx_index[tx_hash] = {
+            "code": res.code,
+            "log": res.log,
+            "height": height,
+            "events": res.events,
+        }
+        # one index entry per tx per key, even when several events of the
+        # same type (multi-msg txs) produce the same key
+        keys = set()
+        for ev in res.events:
+            etype = ev.get("type") if isinstance(ev, dict) else None
+            if not etype:
+                continue
+            keys.add(etype)
+            for k, v in ev.items():
+                if k != "type" and isinstance(v, (str, int, bool)):
+                    keys.add(f"{etype}.{k}={v}")
+        for key in keys:
+            self._event_index.setdefault(key, []).append(tx_hash)
 
     def _persist_commit(self, height, app_hash, roots, forward) -> None:
         self._state_log.append_commit(
@@ -385,11 +411,25 @@ class TestNode:
                 if h <= height - self.eds_cache_blocks
             ]:
                 del self._eds_cache[h]
+        # normalize events to JSON-safe form ONCE; the tx index, the
+        # event index, the block log and the gRPC surface all share it
+        from celestia_tpu.state.app import jsonable_events
+
+        for res in results:
+            res.events[:] = jsonable_events(res.events)
         # index included txs + drop them from the mempool
         for raw, res in zip(block_txs, results):
             h = hashlib.sha256(raw).digest()
-            self._tx_index[h] = {"code": res.code, "log": res.log, "height": height}
+            self._index_tx(h, res, height)
             self.mempool.remove(h)
+        # comet recheck parity: the block just moved state under every
+        # still-pooled tx — re-run CheckTx (recheck mode, fresh check
+        # state branched off the new commit) and evict what no longer
+        # passes, instead of letting stale txs linger until TTL
+        if len(self.mempool):
+            self.mempool.recheck(
+                lambda raw: self.app.check_tx(raw, is_recheck=True).code == 0
+            )
         # txs the proposer dropped stay pooled until their TTL expires
         self.mempool.evict_expired(height)
         if (
@@ -637,6 +677,12 @@ class TestNode:
             from celestia_tpu.state.invariants import assert_invariants
 
             return assert_invariants(self.app)
+        if path == "custom/tx/search":
+            # query-by-event: "transfer", "transfer.recipient=<hex>", ...
+            hashes = self._event_index.get(data["event"], [])
+            return [
+                {"hash": h.hex(), **self._tx_index[h]} for h in hashes
+            ]
         if path == "custom/namespace/shares":
             # GetSharesByNamespace: all shares of one namespace + proofs,
             # with the DAH so a light client can verify completeness

@@ -88,6 +88,22 @@ class TxResult:
     events: List[dict] = field(default_factory=list)
 
 
+def jsonable_events(events: List[dict]) -> List[dict]:
+    """Typed msg events with bytes fields -> JSON-safe form (hex), for
+    the tx index, the event-query routes and the block log."""
+
+    def conv(v):
+        if isinstance(v, bytes):
+            return v.hex()
+        if isinstance(v, dict):
+            return {k: conv(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [conv(x) for x in v]
+        return v
+
+    return [conv(e) for e in events]
+
+
 @dataclass
 class PreparedProposal:
     block_txs: List[bytes]
@@ -534,7 +550,12 @@ class App:
             # auth.NewAccount behavior): clients can then query a stable
             # account number before signing their first tx
             self.accounts.get_or_create(msg.to_addr)
-            return {"type": "transfer", "amount": msg.amount}
+            return {
+                "type": "transfer",
+                "amount": msg.amount,
+                "sender": msg.from_addr.hex(),
+                "recipient": msg.to_addr.hex(),
+            }
         if isinstance(msg, MsgPayForBlobs):
             return self.blob.pay_for_blobs(msg, gas_meter)
         if isinstance(msg, MsgDelegate):

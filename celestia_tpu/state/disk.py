@@ -25,12 +25,16 @@ block being written — never committed history.
 
 from __future__ import annotations
 
+import json
 import os
 import struct
 import zlib
 from typing import Dict, Iterator, List, Optional, Tuple
 
-_MAGIC = b"CTL1"
+# the magic doubles as the format version: any layout change bumps it, so
+# records written by an older layout fail the magic check and scan() stops
+# there instead of misparsing (CTL1 -> CTL2: per-result events field)
+_MAGIC = b"CTL2"
 _T_STATE = 1
 _T_CHECKPOINT = 2
 _T_BLOCK = 3
@@ -290,6 +294,8 @@ class BlockLog:
 
     def append_block(self, block) -> None:
         """block: node.testnode.Block (imported lazily to avoid cycles)."""
+        from celestia_tpu.state.app import jsonable_events
+
         h = block.header
         out: List[bytes] = []
         _pi(out, h.height)
@@ -315,6 +321,7 @@ class BlockLog:
             _pb(out, res.log.encode())
             _pi(out, res.gas_wanted)
             _pi(out, res.gas_used)
+            _pb(out, json.dumps(jsonable_events(res.events)).encode())
         self._log.append(_T_BLOCK, b"".join(out))
 
     def close(self) -> None:
@@ -357,6 +364,7 @@ class BlockLog:
                     log=r.bytes_().decode(),
                     gas_wanted=r.int_(),
                     gas_used=r.int_(),
+                    events=json.loads(r.bytes_()),
                 )
                 for _ in range(r.int_())
             ]

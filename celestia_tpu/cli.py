@@ -233,11 +233,21 @@ def cmd_start(args) -> int:
             snapshot_keep_recent=cfg.snapshot.keep_recent,
             data_dir=data_dir,
         )
+    if getattr(args, "bft_valset", None):
+        # two-phase BFT mode: this node votes with its own key and
+        # commits only on a 2/3 precommit quorum it verified itself
+        valset = json.loads(Path(args.bft_valset).read_text())
+        node.enable_bft(valset)
+        log.info("BFT consensus enabled", validators=len(valset))
     server = NodeServer(
         node,
         address=cfg.grpc.address,
-        # validator mode: the coordinator drives consensus; no self-loop
-        block_interval_s=None if args.validator else cfg.consensus.block_interval_s,
+        # validator mode: an external driver paces consensus; no self-loop
+        block_interval_s=(
+            None
+            if args.validator or getattr(args, "bft_valset", None)
+            else cfg.consensus.block_interval_s
+        ),
     )
     server.start()
     log.info(
@@ -537,6 +547,37 @@ def cmd_coordinator(args) -> int:
     return 0
 
 
+def cmd_bft_relay(args) -> int:
+    from celestia_tpu.client.remote import RemoteNode
+    from celestia_tpu.node.coordinator import BFTRelay, PeerValidator
+
+    peers = [
+        PeerValidator(name=f"val-{i}", client=RemoteNode(addr, timeout_s=args.timeout))
+        for i, addr in enumerate(args.peers.split(","))
+    ]
+    relay = BFTRelay(peers)
+    produced = 0
+    while args.blocks == 0 or produced < args.blocks:
+        t0 = time.time()
+        height = relay.produce_block()
+        app_hash = ""
+        for peer in peers:
+            try:
+                app_hash = peer.client.status().get("app_hash", "")
+                break
+            except Exception:
+                continue
+        print(
+            json.dumps({"height": height, "app_hash": app_hash[:16]}),
+            flush=True,
+        )
+        produced += 1
+        remaining = args.block_interval - (time.time() - t0)
+        if remaining > 0 and (args.blocks == 0 or produced < args.blocks):
+            time.sleep(remaining)
+    return 0
+
+
 def cmd_snapshot(args) -> int:
     from celestia_tpu.node.snapshots import SnapshotStore
 
@@ -659,6 +700,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="validator mode: no self-production; an external coordinator "
              "drives consensus through the ConsPrepare/Process/Commit RPCs",
     )
+    sp.add_argument(
+        "--bft-valset", default=None,
+        help="two-phase BFT mode: path to the validator-set JSON "
+             '([{"address","pubkey","power"}]); this node prevotes/'
+             "precommits with its key and commits only on a 2/3 quorum "
+             "it verified itself (a bft-relay shuttles messages)",
+    )
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser(
@@ -671,6 +719,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--block-interval", type=float, default=1.0)
     sp.add_argument("--timeout", type=float, default=120.0)
     sp.set_defaults(fn=cmd_coordinator)
+
+    sp = sub.add_parser(
+        "bft-relay",
+        help="dumb message transport for two-phase BFT validator "
+             "processes (forwards gossip + echoes timeouts; never "
+             "sequences commits)",
+    )
+    sp.add_argument("--peers", required=True,
+                    help="comma-separated validator gRPC addresses")
+    sp.add_argument("--blocks", type=int, default=0,
+                    help="relay N blocks then exit (0 = run forever)")
+    sp.add_argument("--block-interval", type=float, default=1.0)
+    sp.add_argument("--timeout", type=float, default=120.0)
+    sp.set_defaults(fn=cmd_bft_relay)
 
     sp = sub.add_parser("keys", help="manage the file keyring")
     ks = sp.add_subparsers(dest="keys_cmd", required=True)

@@ -152,6 +152,29 @@ class RemoteNode:
         )
         return bytes.fromhex(out["app_hash"])
 
+    # -- two-phase BFT surface (dumb-relay transport, node/bft.py) ------
+
+    def bft_start(self, height: int) -> None:
+        self._call_json("BftStart", {"height": height})
+
+    def bft_msg(self, wire: dict) -> None:
+        self._call_json("BftMsg", wire)
+
+    def bft_timeout(self, step: str, height: int, round_: int) -> None:
+        self._call_json(
+            "BftTimeout", {"step": step, "height": height, "round": round_}
+        )
+
+    def bft_drain(self) -> dict:
+        return self._call_json("BftDrain", {})
+
+    def bft_decided(self, height: int) -> Optional[dict]:
+        out = self._call_json("BftDecided", {"height": height})
+        return out["decided"] if out["found"] else None
+
+    def bft_catchup(self, decided_wire: dict) -> bool:
+        return bool(self._call_json("BftCatchup", decided_wire)["ok"])
+
     def wait_for_height(self, h: int, timeout_s: float = 60.0) -> None:
         deadline = time.time() + timeout_s
         while self.height < h:

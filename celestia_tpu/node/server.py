@@ -159,6 +159,34 @@ class NodeService:
         )
         return json.dumps({"app_hash": app_hash.hex()}).encode()
 
+    # -- two-phase BFT surface (node/bft.py; the relay is dumb transport)
+
+    def bft_start(self, req: bytes, ctx) -> bytes:
+        q = json.loads(req)
+        self.node.bft_start(int(q["height"]))
+        return b"{}"
+
+    def bft_msg(self, req: bytes, ctx) -> bytes:
+        self.node.bft_msg(json.loads(req))
+        return b"{}"
+
+    def bft_timeout(self, req: bytes, ctx) -> bytes:
+        q = json.loads(req)
+        self.node.bft_timeout(q["step"], int(q["height"]), int(q["round"]))
+        return b"{}"
+
+    def bft_drain(self, req: bytes, ctx) -> bytes:
+        return json.dumps(self.node.bft_drain()).encode()
+
+    def bft_decided(self, req: bytes, ctx) -> bytes:
+        q = json.loads(req)
+        d = self.node.bft_decided(int(q["height"]))
+        return json.dumps({"found": d is not None, "decided": d}).encode()
+
+    def bft_catchup(self, req: bytes, ctx) -> bytes:
+        ok, why = self.node.bft_catchup(json.loads(req))
+        return json.dumps({"ok": ok, "reason": why}).encode()
+
     def query(self, req: bytes, ctx) -> bytes:
         q = json.loads(req or b"{}")
         path = q.get("path", "")
@@ -183,6 +211,12 @@ class NodeService:
             "ConsPrepare": self.cons_prepare,
             "ConsProcess": self.cons_process,
             "ConsCommit": self.cons_commit,
+            "BftStart": self.bft_start,
+            "BftMsg": self.bft_msg,
+            "BftTimeout": self.bft_timeout,
+            "BftDrain": self.bft_drain,
+            "BftDecided": self.bft_decided,
+            "BftCatchup": self.bft_catchup,
         }
         method_handlers = {
             name: grpc.unary_unary_rpc_method_handler(

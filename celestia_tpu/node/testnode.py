@@ -173,6 +173,7 @@ class TestNode:
         self._validator_key = validator_key or PrivateKey.from_seed(
             b"testnode-validator"
         )
+        self._bft = None  # armed by enable_bft()
         if recovered_blocks:
             # disk recovery: resume the chain where the logs end
             self.blocks = recovered_blocks
@@ -216,6 +217,173 @@ class TestNode:
                 genesis["genesis_time_ns"] = genesis_time_ns or _time.time_ns()
         self.app.init_chain(genesis)
         self._now_ns = self.app.genesis_time_ns
+
+    # ------------------------------------------------------------------
+    # two-phase BFT mode (node/bft.py engine; the relay is dumb transport)
+    # ------------------------------------------------------------------
+
+    def enable_bft(self, valset: List[dict]) -> None:
+        """Arm the Tendermint-style consensus engine.  valset entries:
+        {"address": hex, "pubkey": hex (33B compressed), "power": int}.
+        Once enabled, blocks are produced ONLY by BFT decisions — this
+        node prevotes/precommits with its validator key and finalizes
+        when IT observes a 2/3 precommit quorum, never because a
+        coordinator told it to (VERDICT r2 #5)."""
+        from celestia_tpu.node.bft import BFTNode
+
+        validators = {
+            bytes.fromhex(v["address"]): int(v["power"]) for v in valset
+        }
+        pubkeys = {
+            bytes.fromhex(v["address"]): bytes.fromhex(v["pubkey"])
+            for v in valset
+        }
+        own = self._validator_key.public_key().address()
+        if own not in validators:
+            # fail at startup, not as a silent consensus stall later
+            raise ValueError(
+                f"this node's validator key ({own.hex()}) is not in the "
+                "BFT valset — check priv_validator_key.json vs valset.json"
+            )
+        self._bft_block_ids: Dict[int, bytes] = {}
+        self._bft = BFTNode(
+            chain_id=self.chain_id,
+            key=self._validator_key,
+            validators=validators,
+            validate_fn=self._bft_validate,
+            propose_fn=self._bft_propose,
+            on_decide=self._bft_decide,
+            pubkeys=pubkeys,
+        )
+
+    def _bft_validate(self, payload):
+        from celestia_tpu.node.bft import validate_payload_against_chain
+
+        ok, why = validate_payload_against_chain(
+            self._bft, payload, self._bft_block_ids.get(payload.height - 1)
+        )
+        if not ok:
+            return False, f"bad commit certificate: {why}"
+        return self.app.process_proposal(
+            list(payload.txs), payload.square_size, payload.data_root
+        )
+
+    def _bft_propose(self, height: int, round_: int):
+        from celestia_tpu.node.bft import BlockPayload
+
+        mem_txs = self.mempool.reap()
+        try:
+            proposal = self.app.prepare_proposal([t.raw for t in mem_txs])
+        except Exception:
+            return None
+        last_commit = ()
+        prev = self._bft.decided.get(height - 1)
+        if prev is not None:
+            last_commit = tuple(
+                sorted(prev.precommits, key=lambda v: v.validator)
+            )
+        return BlockPayload(
+            height=height,
+            time_ns=self._now_ns + self.block_interval_ns,
+            square_size=proposal.square_size,
+            data_root=proposal.data_root,
+            txs=tuple(proposal.block_txs),
+            proposer=self._validator_key.public_key().address(),
+            last_commit=last_commit,
+        )
+
+    def _bft_decide(self, decided) -> None:
+        from celestia_tpu.node.bft import last_commit_vote_pairs
+
+        payload = decided.payload
+        self._bft_block_ids[payload.height] = payload.block_id
+        for h in [h for h in self._bft_block_ids if h < payload.height - 16]:
+            del self._bft_block_ids[h]
+        # identical LastCommitInfo everywhere: derived from the payload's
+        # certificate over the SORTED valset, never from local votes
+        vote_pairs = last_commit_vote_pairs(self._bft.validators, payload)
+        self._now_ns = payload.time_ns
+        self._apply_block(
+            payload.height, payload.time_ns, list(payload.txs),
+            payload.data_root, payload.square_size,
+            proposer=payload.proposer, votes=vote_pairs,
+        )
+
+    def bft_start(self, height: int) -> None:
+        with self._service_lock:
+            if self._bft is None:
+                raise RuntimeError("BFT mode not enabled")
+            if height != self.height + 1:
+                return  # stale/duplicate start
+            self._bft.start_height(height)
+
+    def bft_msg(self, wire: dict) -> None:
+        with self._service_lock:
+            if self._bft is not None:
+                self._bft.receive(wire)
+
+    def bft_timeout(self, step: str, height: int, round_: int) -> None:
+        with self._service_lock:
+            if self._bft is None:
+                return
+            if step == "propose":
+                self._bft.on_timeout_propose(height, round_)
+            elif step == "prevote":
+                self._bft.on_timeout_prevote(height, round_)
+            elif step == "precommit":
+                self._bft.on_timeout_precommit(height, round_)
+
+    def bft_decided(self, height: int) -> Optional[dict]:
+        """Serve a decided block + its precommit certificate for laggard
+        catch-up.  The certificate is what makes the replay trustless:
+        the receiver verifies the 2/3 signatures, not the sender."""
+        with self._service_lock:
+            if self._bft is None:
+                return None
+            d = self._bft.decided.get(height)
+            if d is None:
+                return None
+            return {
+                "payload": d.payload.to_wire(),
+                "precommits": [v.to_wire() for v in d.precommits],
+            }
+
+    def bft_catchup(self, decided_wire: dict) -> Tuple[bool, str]:
+        """Adopt an externally-replayed decided block after verifying
+        its commit certificate (engine.adopt_decision)."""
+        from celestia_tpu.node.bft import BlockPayload, Vote
+
+        with self._service_lock:
+            if self._bft is None:
+                return False, "BFT mode not enabled"
+            payload = BlockPayload.from_wire(decided_wire["payload"])
+            if payload.height != self.height + 1:
+                return payload.height <= self.height, "not the next height"
+            precommits = [
+                Vote.from_wire(v) for v in decided_wire["precommits"]
+            ]
+            return self._bft.adopt_decision(payload, precommits)
+
+    def bft_drain(self) -> dict:
+        """Hand the transport everything outbound: gossip messages and
+        due-timeout requests.  The transport forwards messages verbatim
+        and echoes timeouts back via bft_timeout — it makes no consensus
+        decisions (the 'dumb relay' contract)."""
+        with self._service_lock:
+            if self._bft is None:
+                return {"outbox": [], "timeouts": [], "height": self.height}
+            out = list(self._bft.outbox)
+            self._bft.outbox.clear()
+            timeouts = list(self._bft.timeout_requests)
+            self._bft.timeout_requests.clear()
+            return {
+                "outbox": out,
+                "timeouts": [
+                    {"step": s, "height": h, "round": r}
+                    for s, h, r in timeouts
+                ],
+                "height": self.height,
+            }
 
     # ------------------------------------------------------------------
     # persistence

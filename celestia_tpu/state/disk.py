@@ -131,7 +131,12 @@ class _Log:
     @staticmethod
     def scan(path: str) -> Iterator[Tuple[int, bytes, int]]:
         """Yield (type, payload, end_offset) for every intact record; stop
-        at the first torn/corrupt frame."""
+        at the first torn/corrupt frame.
+
+        A log whose FIRST record carries an older format magic (CTL*)
+        is a pre-upgrade data dir, not a torn tail — raise instead of
+        silently treating the whole chain as garbage (recovery would
+        otherwise truncate it to zero and reset to genesis)."""
         if not os.path.exists(path):
             return
         with open(path, "rb") as f:
@@ -140,6 +145,13 @@ class _Log:
         while pos + _HEADER.size <= len(data):
             magic, rtype, n, crc = _HEADER.unpack_from(data, pos)
             if magic != _MAGIC:
+                if pos == 0 and magic[:3] == _MAGIC[:3]:
+                    raise RuntimeError(
+                        f"{path} was written by log format "
+                        f"{magic.decode(errors='replace')} but this build "
+                        f"reads {_MAGIC.decode()}; refusing to destroy it "
+                        "— migrate or move the data dir aside"
+                    )
                 break
             payload = data[pos + _HEADER.size : pos + _HEADER.size + n]
             if len(payload) != n or zlib.crc32(payload) != crc:

@@ -1,0 +1,480 @@
+"""Two-phase BFT consensus: safety and liveness.
+
+VERDICT r2 next-round #5 "done" criteria:
+- safety: conflicting proposals in one height can't both commit; a
+  locked validator refuses a competing proposal;
+- liveness: proposer crash -> timeout-driven view change;
+- no central sequencer: every validator decides from votes it verified.
+
+Reference role: celestia-core consensus (SURVEY §2.2), Tendermint
+algorithm (arXiv:1807.04938), specs/consensus.md.
+"""
+
+import pytest
+
+from celestia_tpu.client.signer import Signer
+from celestia_tpu.node.bft import (
+    NIL,
+    PRECOMMIT,
+    PREVOTE,
+    STEP_PRECOMMIT,
+    BlockPayload,
+    Proposal,
+    Vote,
+    proposal_sign_bytes,
+    vote_sign_bytes,
+)
+from celestia_tpu.node.bft_network import BFTNetwork
+from celestia_tpu.node.network import ConsensusFailure
+from celestia_tpu.state.tx import MsgSend
+from celestia_tpu.utils.secp256k1 import PrivateKey
+
+
+# ---------------------------------------------------------------------------
+# happy path
+# ---------------------------------------------------------------------------
+
+
+def test_four_validators_commit_blocks_and_agree():
+    net = BFTNetwork(n_validators=4)
+    alice = PrivateKey.from_seed(b"bft-alice")
+    net2 = None  # keep flake8 quiet
+    blocks = net.produce_blocks(3)
+    assert [b.header.height for b in blocks] == [2, 3, 4]
+    # every validator finalized every height with the same app hash
+    for h, blk in zip((2, 3, 4), blocks):
+        hashes = {v.finalized[h] for v in net.validators}
+        assert hashes == {blk.header.app_hash}
+    # each decision carries a >= 2/3 commit certificate of real precommits
+    for val in net.validators:
+        cert = val.engine.decided[2].precommits
+        power = sum(val.engine.validators[v.validator] for v in cert)
+        assert power * 3 >= val.engine.total_power * 2
+
+
+def test_txs_flow_through_bft_consensus():
+    alice = PrivateKey.from_seed(b"bft-alice")
+    net = BFTNetwork(n_validators=4, funded_accounts=[(alice, 10**12)])
+    signer = Signer(net, alice)
+    bob = b"\x31" * 20
+    raw = signer.sign_tx([MsgSend(signer.address, bob, 4_000)]).marshal()
+    res = net.broadcast_tx(raw)
+    assert res.code == 0, res.log
+    net.produce_block()
+    for val in net.validators:
+        assert val.app.bank.balance(bob) == 4_000
+    info = net.get_tx(res.tx_hash)
+    assert info and info["code"] == 0
+
+
+def test_commit_certificate_feeds_next_blocks_last_commit():
+    net = BFTNetwork(n_validators=4)
+    net.produce_blocks(2)
+    # block 3's payload carries the precommit certificate for block 2
+    blk3_payload = net.validators[0].engine.decided[3].payload
+    assert blk3_payload.last_commit, "height 3 must carry height 2's commit"
+    for v in blk3_payload.last_commit:
+        assert v.vtype == PRECOMMIT
+        assert v.height == 2
+
+
+# ---------------------------------------------------------------------------
+# liveness: crashes and partitions
+# ---------------------------------------------------------------------------
+
+
+def test_proposer_crash_triggers_view_change():
+    net = BFTNetwork(n_validators=4)
+    # find who proposes height 2 round 0 and crash them
+    eng = net.validators[0].engine
+    proposer_addr = eng.proposer_for(2, 0)
+    victim = next(v for v in net.validators if v.address == proposer_addr)
+    victim.crashed = True
+    blk = net.produce_block()
+    assert blk.header.height == 2
+    # the block was decided at round >= 1 (view change happened)
+    live = next(v for v in net.validators if not v.crashed)
+    assert live.engine.decided[2].round >= 1
+    # and NOT proposed by the crashed validator
+    assert blk.proposer != victim.address
+
+
+def test_one_third_partition_stalls_then_heals():
+    """With 1 of 4 validators cut off, the remaining 3/4 power still
+    commits; the partitioned validator cannot (no quorum alone)."""
+    net = BFTNetwork(n_validators=4)
+    isolated = net.validators[3]
+    net.partition(
+        [isolated.name], [v.name for v in net.validators[:3]]
+    )
+    # the isolated node runs but never decides; exclude it from the wait
+    isolated.crashed = True  # harness-level: don't wait for its decision
+    blk = net.produce_block()
+    assert blk.header.height == 2
+    assert 2 not in isolated.engine.decided
+
+
+def test_below_two_thirds_cannot_commit():
+    """2 of 4 equal-power validators (50%) can never reach the 2/3
+    precommit quorum — the height must stall, not commit."""
+    net = BFTNetwork(n_validators=4)
+    net.validators[2].crashed = True
+    net.validators[3].crashed = True
+    with pytest.raises(RuntimeError, match="stalled|did not decide"):
+        net.produce_block(max_steps=30)
+
+
+# ---------------------------------------------------------------------------
+# safety: locking and conflicting proposals
+# ---------------------------------------------------------------------------
+
+
+def _forge_proposal(net, byz_val, height, round_, data_root_tweak):
+    """Build a signed proposal from byz_val with a tweaked payload."""
+    mem = []
+    proposal = byz_val.app.prepare_proposal(mem)
+    payload = BlockPayload(
+        height=height,
+        time_ns=net._now_ns + net.block_interval_ns,
+        square_size=proposal.square_size,
+        data_root=data_root_tweak,
+        txs=tuple(proposal.block_txs),
+        proposer=byz_val.address,
+        last_commit=tuple(
+            sorted(
+                byz_val.engine.decided[height - 1].precommits,
+                key=lambda v: v.validator,
+            )
+        )
+        if (height - 1) in byz_val.engine.decided
+        else (),
+    )
+    sig = byz_val.key.sign(
+        proposal_sign_bytes(
+            net.chain_id, height, round_, -1, payload.block_id
+        )
+    )
+    return Proposal(
+        height=height,
+        round=round_,
+        pol_round=-1,
+        payload=payload,
+        proposer=byz_val.address,
+        signature=sig,
+    )
+
+
+def test_equivocating_proposer_cannot_double_commit():
+    """A byzantine proposer sends proposal A to half the network and
+    proposal B to the other half.  At most one can commit; no two
+    validators decide different blocks."""
+    net = BFTNetwork(n_validators=4)
+    net.produce_block()  # height 2 settles, certificates exist
+    height = net.height + 1
+    eng = net.validators[0].engine
+    proposer_addr = eng.proposer_for(height, 0)
+    byz = next(v for v in net.validators if v.address == proposer_addr)
+    honest = [v for v in net.validators if v is not byz]
+
+    # the real proposal (A) and a conflicting one (B, forged data root)
+    prop_a = _forge_proposal(net, byz, height, 0, b"\xaa" * 32)
+    prop_b = _forge_proposal(net, byz, height, 0, b"\xbb" * 32)
+    assert prop_a.payload.block_id != prop_b.payload.block_id
+
+    for v in net.validators:
+        v.engine.start_height(height)
+    # byzantine delivery: A to honest[0], B to honest[1] and honest[2]
+    honest[0].engine.receive(prop_a.to_wire())
+    honest[1].engine.receive(prop_b.to_wire())
+    honest[2].engine.receive(prop_b.to_wire())
+    # both proposals fail ProcessProposal (forged data roots), so honest
+    # validators prevote nil — but even if they HAD validated, the split
+    # could not reach 2/3 for both.  Pump until quiescent (bounded).
+    net._drain_outboxes()
+    for _ in range(40):
+        net._deliver_all()
+        if all(height in v.engine.decided for v in net.validators):
+            break
+        if not net._fire_due_timeouts():
+            break
+        net._drain_outboxes()
+    decided_ids = {
+        v.engine.decided[height].payload.block_id
+        for v in net.validators
+        if height in v.engine.decided
+    }
+    assert len(decided_ids) <= 1, "two conflicting blocks committed"
+
+
+def test_locked_validator_refuses_competing_proposal():
+    """Drive one validator to lock on block A (via a polka), then offer
+    it a competing proposal B in the next round: it must prevote NIL on
+    B while locked."""
+    net = BFTNetwork(n_validators=4)
+    net.produce_block()
+    height = net.height + 1
+    eng0 = net.validators[0].engine
+    # let round 0 play out normally up to the polka on A, but withhold
+    # precommits from the observer so nothing commits
+    proposer_addr = eng0.proposer_for(height, 0)
+    r1_addr = eng0.proposer_for(height, 1)
+    proposer = next(v for v in net.validators if v.address == proposer_addr)
+    others = [v for v in net.validators if v is not proposer]
+    # the observer must propose in NEITHER round 0 nor round 1, so it
+    # purely receives both proposals
+    val0 = next(
+        v for v in net.validators
+        if v.address not in (proposer_addr, r1_addr)
+    )
+
+    for v in net.validators:
+        v.engine.start_height(height)
+    net._drain_outboxes()
+    # deliver the proposal + everyone's prevotes to the observer ONLY
+    msgs = list(net._queue)
+    net._queue.clear()
+    for sender, wire in msgs:
+        if sender != val0.name:
+            val0.engine.receive(wire)
+    # val0 must now have prevoted A; feed it the other validators'
+    # prevotes for A so it sees the polka and locks
+    prop = next(w for s, w in msgs if w["kind"] == "proposal")
+    block_a = bytes.fromhex(prop["payload"]["data_root"])
+    payload_a_id = val0.engine._proposals[(height, 0)].payload.block_id
+    for v in others:
+        if v is val0:
+            continue
+        vote = Vote(
+            vtype=PREVOTE, height=height, round=0,
+            block_id=payload_a_id, validator=v.address,
+            signature=v.key.sign(
+                vote_sign_bytes(net.chain_id, height, 0, PREVOTE, payload_a_id)
+            ),
+        )
+        val0.engine.receive(vote.to_wire())
+    assert val0.engine.locked_round == 0
+    assert val0.engine.locked_payload.block_id == payload_a_id
+    assert val0.engine.step == STEP_PRECOMMIT
+
+    # round moves on; competing proposal B arrives in round 1 from the
+    # correct round-1 proposer
+    val0.engine.on_timeout_precommit(height, 0)
+    assert val0.engine.round == 1
+    r1_proposer_addr = eng0.proposer_for(height, 1)
+    r1_proposer = next(
+        v for v in net.validators if v.address == r1_proposer_addr
+    )
+    prop_b = _forge_proposal(net, r1_proposer, height, 1, b"\xcc" * 32)
+    val0.engine.outbox.clear()
+    val0.engine.receive(prop_b.to_wire())
+    # val0 is locked on A: its round-1 prevote must be NIL, not B
+    prevotes = [
+        w for w in val0.engine.outbox
+        if w["kind"] == "vote" and w["vtype"] == PREVOTE and w["round"] == 1
+    ]
+    assert prevotes, "locked validator must still prevote (nil)"
+    assert all(w["block_id"] == "" for w in prevotes), (
+        "locked validator prevoted a competing block"
+    )
+
+
+def test_forged_votes_do_not_count():
+    """Votes with bad signatures or from non-validators never reach a
+    quorum."""
+    net = BFTNetwork(n_validators=4)
+    net.produce_block()
+    height = net.height + 1
+    val0 = net.validators[0]
+    val0.engine.start_height(height)
+    attacker = PrivateKey.from_seed(b"not-a-validator")
+    fake_block = b"\xdd" * 32
+    # non-validator signature
+    v1 = Vote(
+        vtype=PRECOMMIT, height=height, round=0, block_id=fake_block,
+        validator=attacker.public_key().address(),
+        signature=attacker.sign(
+            vote_sign_bytes(net.chain_id, height, 0, PRECOMMIT, fake_block)
+        ),
+    )
+    # claimed validator address with attacker's signature
+    v2 = Vote(
+        vtype=PRECOMMIT, height=height, round=0, block_id=fake_block,
+        validator=net.validators[1].address,
+        signature=attacker.sign(
+            vote_sign_bytes(net.chain_id, height, 0, PRECOMMIT, fake_block)
+        ),
+    )
+    val0.engine.receive(v1.to_wire())
+    val0.engine.receive(v2.to_wire())
+    slot = val0.engine._votes.get((height, 0, PRECOMMIT), {})
+    assert not slot, "forged votes were stored"
+
+
+def test_double_vote_reported_as_equivocation():
+    net = BFTNetwork(n_validators=4)
+    net.produce_block()
+    height = net.height + 1
+    val0, val1 = net.validators[0], net.validators[1]
+    val0.engine.start_height(height)
+    a, b = b"\xee" * 32, b"\xef" * 32
+    for bid in (a, b):
+        val0.engine.receive(
+            Vote(
+                vtype=PREVOTE, height=height, round=0, block_id=bid,
+                validator=val1.address,
+                signature=val1.key.sign(
+                    vote_sign_bytes(net.chain_id, height, 0, PREVOTE, bid)
+                ),
+            ).to_wire()
+        )
+    assert len(net.equivocations) == 1
+    va, vb = net.equivocations[0]
+    assert va.validator == val1.address
+    assert {va.block_id, vb.block_id} == {a, b}
+
+
+def test_first_height_rejects_nonempty_last_commit():
+    """Regression: the first BFT height has no previous certificate, so
+    a proposer must not be able to smuggle fabricated votes into
+    LastCommitInfo via a non-empty last_commit."""
+    from celestia_tpu.node.bft import validate_payload_against_chain
+
+    net = BFTNetwork(n_validators=4)
+    val0 = net.validators[0]
+    fake_vote = Vote(
+        vtype=PRECOMMIT, height=1, round=0, block_id=b"\x01" * 32,
+        validator=val0.address, signature=b"\x00" * 64,
+    )
+    payload = BlockPayload(
+        height=2, time_ns=1, square_size=1, data_root=b"\x02" * 32,
+        txs=(), proposer=val0.address, last_commit=(fake_vote,),
+    )
+    ok, why = validate_payload_against_chain(val0.engine, payload, None)
+    assert not ok
+    assert "empty" in why
+    # and with an empty certificate it passes the chain check
+    clean = BlockPayload(
+        height=2, time_ns=1, square_size=1, data_root=b"\x02" * 32,
+        txs=(), proposer=val0.address,
+    )
+    ok, _ = validate_payload_against_chain(val0.engine, clean, None)
+    assert ok
+
+
+def test_adopt_decision_requires_valid_certificate():
+    """Catch-up replay is trustless: adopt_decision verifies the 2/3
+    precommit signatures, not the replayer."""
+    net = BFTNetwork(n_validators=4)
+    net.produce_blocks(2)
+    src = net.validators[0].engine
+    decided = src.decided[3]
+    # a fresh engine (same valset) accepts the genuine certificate
+    spare_key = net.validators[1].key
+    from celestia_tpu.node.bft import BFTNode
+
+    fresh = BFTNode(
+        chain_id=net.chain_id, key=spare_key,
+        validators=dict(src.validators),
+        validate_fn=lambda p: (True, ""),
+        propose_fn=lambda h, r: None,
+        pubkeys=dict(src.pubkeys),
+    )
+    ok, why = fresh.adopt_decision(
+        decided.payload, list(decided.precommits)
+    )
+    assert ok, why
+    assert 3 in fresh.decided
+    # a tampered certificate (flipped block id) is refused
+    fresh2 = BFTNode(
+        chain_id=net.chain_id, key=spare_key,
+        validators=dict(src.validators),
+        validate_fn=lambda p: (True, ""),
+        propose_fn=lambda h, r: None,
+        pubkeys=dict(src.pubkeys),
+    )
+    bad = [
+        Vote(
+            vtype=v.vtype, height=v.height, round=v.round,
+            block_id=b"\x13" * 32, validator=v.validator,
+            signature=v.signature,
+        )
+        for v in decided.precommits
+    ]
+    ok, _ = fresh2.adopt_decision(decided.payload, bad)
+    assert not ok
+    # an under-powered certificate (one vote) is refused
+    fresh3 = BFTNode(
+        chain_id=net.chain_id, key=spare_key,
+        validators=dict(src.validators),
+        validate_fn=lambda p: (True, ""),
+        propose_fn=lambda h, r: None,
+        pubkeys=dict(src.pubkeys),
+    )
+    ok, why = fresh3.adopt_decision(
+        decided.payload, [decided.precommits[0]]
+    )
+    assert not ok
+    assert "2/3" in why
+
+
+def test_forged_commit_certificate_rejected():
+    """A proposer cannot inflate its last_commit with unsigned/forged
+    entries: verify_commit_certificate refuses them."""
+    net = BFTNetwork(n_validators=4)
+    net.produce_blocks(2)
+    val0 = net.validators[0]
+    decided = val0.engine.decided[3]
+    prev_id = decided.payload.block_id
+    good_cert = tuple(val0.engine.decided[3].precommits)
+    payload = BlockPayload(
+        height=4, time_ns=net._now_ns + 1, square_size=1,
+        data_root=b"\x11" * 32, txs=(),
+        proposer=val0.address, last_commit=good_cert,
+    )
+    ok, _ = val0.engine.verify_commit_certificate(payload, prev_id, 3)
+    assert ok
+    # tamper: flip one vote's block id (signature no longer matches)
+    bad_vote = Vote(
+        vtype=PRECOMMIT, height=3, round=good_cert[0].round,
+        block_id=b"\x22" * 32, validator=good_cert[0].validator,
+        signature=good_cert[0].signature,
+    )
+    bad = payload.__class__(
+        **{**payload.__dict__, "last_commit": (bad_vote,) + good_cert[1:]}
+    )
+    ok, why = val0.engine.verify_commit_certificate(bad, prev_id, 3)
+    assert not ok
+
+
+# ---------------------------------------------------------------------------
+# byzantine app: the legacy malicious-proposer scenario on the BFT engine
+# ---------------------------------------------------------------------------
+
+
+def test_invalid_proposal_is_rejected_and_chain_continues():
+    """A proposal that fails ProcessProposal draws nil prevotes; the
+    round times out and the next proposer commits a valid block."""
+    net = BFTNetwork(n_validators=4)
+    net.produce_block()
+    height = net.height + 1
+    eng = net.validators[0].engine
+    proposer_addr = eng.proposer_for(height, 0)
+    byz = next(v for v in net.validators if v.address == proposer_addr)
+    # replace the byzantine proposer's propose_fn with one that forges
+    # the data root (ProcessProposal everywhere else must reject it)
+    original_fn = byz.engine.propose_fn
+
+    def evil_propose(h, r):
+        payload = original_fn(h, r)
+        if payload is None or r > 0:
+            return payload  # only round 0 is malicious
+        return BlockPayload(
+            **{**payload.__dict__, "data_root": b"\x66" * 32}
+        )
+
+    byz.engine.propose_fn = evil_propose
+    blk = net.produce_block()
+    assert blk.header.height == height
+    assert blk.header.data_hash != b"\x66" * 32
+    live = net.validators[1]
+    assert live.engine.decided[height].round >= 1

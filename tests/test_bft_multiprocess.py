@@ -1,0 +1,278 @@
+"""Two-phase BFT across real process/network boundaries.
+
+VERDICT r2 next-round #5: the multi-process tier must commit through
+prevote/precommit quorums each validator verifies itself, with the relay
+acting as dumb transport only.  Tier 1 here runs three full node+gRPC
+servers in one process (real network boundary, fast); tier 2 runs three
+``celestia-tpu start --bft-valset`` OS processes driven by the
+``bft-relay`` CLI — nothing shared but genesis and addresses.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from celestia_tpu.client.remote import RemoteNode
+from celestia_tpu.client.signer import Signer
+from celestia_tpu.node.coordinator import BFTRelay, PeerValidator
+from celestia_tpu.node.server import NodeServer
+from celestia_tpu.node.testnode import TestNode
+from celestia_tpu.state.tx import MsgSend
+from celestia_tpu.utils.secp256k1 import PrivateKey
+
+REPO = Path(__file__).resolve().parents[1]
+
+_CHILD_ENV = {
+    **os.environ,
+    "CELESTIA_JAX_PLATFORM": "cpu",
+    "JAX_PLATFORMS": "cpu",
+    "TF_CPP_MIN_LOG_LEVEL": "3",
+}
+
+
+def _valset(keys, power=100):
+    return [
+        {
+            "address": k.public_key().address().hex(),
+            "pubkey": k.public_key().compressed().hex(),
+            "power": power,
+        }
+        for k in keys
+    ]
+
+
+def _genesis(keys, chain_id, funded=None):
+    return {
+        "chain_id": chain_id,
+        "genesis_time_ns": 1_700_000_000_000_000_000,
+        "accounts": [
+            {"address": k.public_key().address().hex(), "balance": 10**12}
+            for k in keys
+        ]
+        + [
+            {"address": key.public_key().address().hex(), "balance": bal}
+            for key, bal in (funded or [])
+        ],
+        "validators": [
+            {
+                "address": k.public_key().address().hex(),
+                "self_delegation": 100_000_000,
+            }
+            for k in keys
+        ],
+    }
+
+
+def test_bft_over_grpc_three_servers():
+    """Three node+gRPC servers, one dumb relay: blocks commit via each
+    node's own 2/3-quorum decision; state replicates identically."""
+    from celestia_tpu.da import dah as dah_mod
+
+    for k in (1, 2):
+        dah_mod.extend_and_header(np.zeros((k, k, 512), dtype=np.uint8))
+
+    keys = [PrivateKey.from_seed(b"bftgrpc-val-%d" % i) for i in range(3)]
+    alice = PrivateKey.from_seed(b"bftgrpc-alice")
+    genesis = _genesis(keys, "bftgrpc-1", funded=[(alice, 10**12)])
+    valset = _valset(keys)
+
+    nodes, servers, remotes = [], [], []
+    try:
+        for i in range(3):
+            node = TestNode(
+                chain_id="bftgrpc-1",
+                genesis=genesis,
+                validator_key=keys[i],
+                auto_produce=False,
+            )
+            node.enable_bft(valset)
+            server = NodeServer(node, block_interval_s=None)
+            server.start()
+            nodes.append(node)
+            servers.append(server)
+            remotes.append(RemoteNode(server.address, timeout_s=120.0))
+
+        relay = BFTRelay(
+            [
+                PeerValidator(name=f"val-{i}", client=r)
+                for i, r in enumerate(remotes)
+            ]
+        )
+        relay.produce_block()
+        assert [n.height for n in nodes] == [2, 2, 2]
+        hashes = {n.blocks[-1].header.app_hash for n in nodes}
+        assert len(hashes) == 1
+
+        # a tx gossiped to every node flows through BFT and replicates
+        signer = Signer(remotes[0], alice)
+        raw = signer.sign_tx(
+            [MsgSend(signer.address, b"\x51" * 20, 9_000)]
+        ).marshal()
+        for r in remotes:
+            res = r.broadcast_tx(raw)
+            assert res.code == 0, res.log
+        relay.produce_block()
+        for n in nodes:
+            assert n.app.bank.balance(b"\x51" * 20) == 9_000
+        hashes = {n.blocks[-1].header.app_hash for n in nodes}
+        assert len(hashes) == 1
+        # the decision was each node's own: every engine holds a >= 2/3
+        # commit certificate for the decided block
+        for n in nodes:
+            decided = n._bft.decided[3]
+            power = sum(
+                n._bft.validators[v.validator] for v in decided.precommits
+            )
+            assert power * 3 >= n._bft.total_power * 2
+    finally:
+        for s in servers:
+            s.stop()
+        for r in remotes:
+            r.close()
+
+
+def test_bft_relay_survives_one_unreachable_validator():
+    """2 of 3 powers still commit when one node's server dies; the relay
+    is transport, not a quorum participant."""
+    from celestia_tpu.da import dah as dah_mod
+
+    for k in (1,):
+        dah_mod.extend_and_header(np.zeros((k, k, 512), dtype=np.uint8))
+
+    keys = [PrivateKey.from_seed(b"bftdown-val-%d" % i) for i in range(3)]
+    genesis = _genesis(keys, "bftdown-1")
+    valset = _valset(keys)
+    nodes, servers, remotes = [], [], []
+    try:
+        for i in range(3):
+            node = TestNode(
+                chain_id="bftdown-1", genesis=genesis,
+                validator_key=keys[i], auto_produce=False,
+            )
+            node.enable_bft(valset)
+            server = NodeServer(node, block_interval_s=None)
+            server.start()
+            nodes.append(node)
+            servers.append(server)
+            remotes.append(RemoteNode(server.address, timeout_s=10.0))
+        relay = BFTRelay(
+            [
+                PeerValidator(name=f"val-{i}", client=r)
+                for i, r in enumerate(remotes)
+            ]
+        )
+        relay.produce_block()
+        # kill validator 2's server; 2/3 power remains
+        servers[2].stop()
+        relay.produce_block()
+        assert nodes[0].height == nodes[1].height == 3
+        assert (
+            nodes[0].blocks[-1].header.app_hash
+            == nodes[1].blocks[-1].header.app_hash
+        )
+        assert nodes[2].height == 2  # the dead node missed the block
+        # laggard catch-up: bring the node back (new server, same node)
+        # — the relay replays the missed block's certificate and the
+        # node verifies + applies it before the next height
+        revived = NodeServer(nodes[2], block_interval_s=None)
+        revived.start()
+        servers.append(revived)
+        r2 = RemoteNode(revived.address, timeout_s=10.0)
+        remotes.append(r2)
+        relay.peers[2] = PeerValidator(name="val-2", client=r2)
+        relay.produce_block()
+        assert nodes[2].height == nodes[0].height == 4
+        assert (
+            nodes[2].blocks[-1].header.app_hash
+            == nodes[0].blocks[-1].header.app_hash
+        )
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        for r in remotes:
+            r.close()
+
+
+@pytest.mark.slow
+def test_bft_three_os_processes(tmp_path_factory):
+    """Full dress: three ``start --bft-valset`` OS processes + the
+    ``bft-relay`` CLI.  Nothing shared but genesis, the valset file and
+    gRPC addresses; every process commits on its own quorum check."""
+    base = tmp_path_factory.mktemp("bftprocnet")
+    val_keys = [PrivateKey.from_seed(b"bftproc-val-%d" % i) for i in range(3)]
+    genesis = _genesis(val_keys, "bftproc-3")
+    shared = base / "genesis.json"
+    shared.write_text(json.dumps(genesis))
+    valset_file = base / "valset.json"
+    valset_file.write_text(json.dumps(_valset(val_keys)))
+
+    def _cli(home, *args, timeout=420):
+        return subprocess.run(
+            [sys.executable, "-m", "celestia_tpu.cli", "--home", str(home),
+             *args],
+            capture_output=True, text=True, timeout=timeout, cwd=REPO,
+            env=_CHILD_ENV,
+        )
+
+    nodes, addrs = [], []
+    try:
+        for i in range(3):
+            home = base / f"val{i}"
+            out = _cli(home, "init", "--chain-id", "bftproc-3",
+                       "--genesis", str(shared), timeout=60)
+            assert out.returncode == 0, out.stderr
+            key_file = home / "config" / "priv_validator_key.json"
+            key_file.write_text(
+                json.dumps({"priv_key": f"{val_keys[i].d:064x}"})
+            )
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "celestia_tpu.cli",
+                    "--home", str(home), "start",
+                    "--bft-valset", str(valset_file),
+                    "--grpc-address", "127.0.0.1:0",
+                ],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, cwd=REPO, env=_CHILD_ENV,
+            )
+            line = proc.stdout.readline()
+            assert proc.poll() is None, f"validator {i} died at startup"
+            addrs.append(json.loads(line)["grpc"])
+            nodes.append(proc)
+
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "celestia_tpu.cli", "bft-relay",
+                "--peers", ",".join(addrs), "--blocks", "3",
+                "--block-interval", "0.1",
+            ],
+            capture_output=True, text=True, timeout=420, cwd=REPO,
+            env=_CHILD_ENV,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
+        assert [b["height"] for b in lines] == [2, 3, 4]
+        statuses = []
+        for addr in addrs:
+            res = _cli(base / "val0", "status", "--node", addr)
+            statuses.append(json.loads(res.stdout.strip().splitlines()[-1]))
+        assert {s["height"] for s in statuses} == {4}
+        assert len({s["app_hash"] for s in statuses}) == 1
+    finally:
+        for proc in nodes:
+            proc.send_signal(signal.SIGINT)
+        for proc in nodes:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()

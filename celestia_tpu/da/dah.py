@@ -215,6 +215,41 @@ def extend_and_header(
     return eds, dah
 
 
+def extend_and_header_breakdown(square: np.ndarray):
+    """extend_and_header with the transfer budget split out: returns
+    (eds, dah, {"upload_ms", "compute_ms", "fetch_ms"}).
+
+    Three device syncs instead of one fused call, so the total is a few
+    RTTs WORSE than extend_and_header — use it to attribute time (bench
+    breakdown, SURVEY §7 hard part c), never on the hot path."""
+    import time as _t
+
+    square = np.asarray(square, dtype=np.uint8)
+    k = square.shape[0]
+    t0 = _t.time()
+    dev = jax.device_put(jnp.asarray(square))
+    dev.block_until_ready()
+    t1 = _t.time()
+    out = _extend_and_roots_fn(k)(dev)
+    jax.block_until_ready(out)
+    t2 = _t.time()
+    eds_d, row_roots, col_roots, data_root = out
+    rr = np.asarray(row_roots)
+    cc = np.asarray(col_roots)
+    droot = np.asarray(data_root).tobytes()
+    t3 = _t.time()
+    dah = DataAvailabilityHeader(
+        tuple(rr[i].tobytes() for i in range(rr.shape[0])),
+        tuple(cc[i].tobytes() for i in range(cc.shape[0])),
+        droot,
+    )
+    return ExtendedDataSquare(eds_d), dah, {
+        "upload_ms": (t1 - t0) * 1000.0,
+        "compute_ms": (t2 - t1) * 1000.0,
+        "fetch_ms": (t3 - t2) * 1000.0,
+    }
+
+
 _eds_nmt_roots_jit = jax.jit(nmt_ops.eds_nmt_roots)  # one cache for all calls
 
 

@@ -64,15 +64,20 @@ def block_id_of(
     data_root: bytes,
     proposer: bytes,
     last_commit_digest: bytes,
+    prev_app_hash: bytes = b"",
 ) -> bytes:
     """The consensus block id: commits to EVERY field that feeds
     finalization — height, timestamp, layout, the data root (which
-    commits to every tx byte via the DAH), the proposer and the previous
+    commits to every tx byte via the DAH), the proposer, the previous
     block's commit certificate (LastCommitInfo feeds distribution and
-    slashing, so replicas must agree on it byte-for-byte)."""
+    slashing, so replicas must agree on it byte-for-byte) and the app
+    hash the previous block produced (Tendermint's header.AppHash: this
+    is what lets a commit certificate double as a LIGHT-CLIENT proof of
+    the chain's state root, the ibc 07-tendermint role)."""
     return hashlib.sha256(
         b"block-id" + _varint(height) + _varint(time_ns)
         + _varint(square_size) + data_root + proposer + last_commit_digest
+        + prev_app_hash
     ).digest()
 
 
@@ -116,6 +121,11 @@ class BlockPayload:
     txs: Tuple[bytes, ...]
     proposer: bytes = b""
     last_commit: Tuple["Vote", ...] = ()
+    # the app hash committed by block height-1 (Tendermint header.AppHash);
+    # replicas reject a payload whose value differs from their own commit,
+    # so a 2/3 certificate over this block id PROVES the state root to
+    # IBC light clients
+    prev_app_hash: bytes = b""
 
     def last_commit_digest(self) -> bytes:
         h = hashlib.sha256(b"last-commit")
@@ -130,8 +140,21 @@ class BlockPayload:
     def block_id(self) -> bytes:
         return block_id_of(
             self.height, self.time_ns, self.square_size, self.data_root,
-            self.proposer, self.last_commit_digest(),
+            self.proposer, self.last_commit_digest(), self.prev_app_hash,
         )
+
+    def header_fields(self) -> dict:
+        """The block-id preimage WITHOUT txs — what an IBC light client
+        needs to recompute the id a commit certificate signs."""
+        return {
+            "height": self.height,
+            "time_ns": self.time_ns,
+            "square_size": self.square_size,
+            "data_root": self.data_root.hex(),
+            "proposer": self.proposer.hex(),
+            "last_commit_digest": self.last_commit_digest().hex(),
+            "prev_app_hash": self.prev_app_hash.hex(),
+        }
 
     def commit_signers(self) -> Set[bytes]:
         return {v.validator for v in self.last_commit}
@@ -145,6 +168,7 @@ class BlockPayload:
             "txs": [t.hex() for t in self.txs],
             "proposer": self.proposer.hex(),
             "last_commit": [v.to_wire() for v in self.last_commit],
+            "prev_app_hash": self.prev_app_hash.hex(),
         }
 
     @classmethod
@@ -159,6 +183,7 @@ class BlockPayload:
             last_commit=tuple(
                 Vote.from_wire(v) for v in d.get("last_commit", [])
             ),
+            prev_app_hash=bytes.fromhex(d.get("prev_app_hash", "")),
         )
 
 
@@ -244,6 +269,7 @@ def validate_payload_against_chain(
     payload: BlockPayload,
     prev_block_id: Optional[bytes],
     first_bft_height: int = 2,
+    expected_prev_app_hash: Optional[bytes] = None,
 ) -> Tuple[bool, str]:
     """Shared certificate-validation glue for every transport tier.
 
@@ -252,7 +278,15 @@ def validate_payload_against_chain(
       fabricated (unverified) votes into LastCommitInfo.
     - Past it, the previous block id must be known and the certificate
       must verify at >= 2/3 power (verify_commit_certificate).
+    - When the validator knows its own committed app hash for height-1,
+      the payload's prev_app_hash must equal it — this is what turns a
+      commit certificate into a light-client-verifiable state-root proof
+      (Tendermint header.AppHash semantics).
     """
+    if expected_prev_app_hash is not None and payload.prev_app_hash != (
+        expected_prev_app_hash
+    ):
+        return False, "prev_app_hash does not match the committed state"
     if payload.height <= first_bft_height:
         if payload.last_commit:
             return False, "first BFT height must carry an empty last_commit"

@@ -143,9 +143,15 @@ class BFTNetwork:
         from celestia_tpu.node.bft import validate_payload_against_chain
 
         def validate(payload: BlockPayload) -> Tuple[bool, str]:
-            # 1. the commit certificate for height-1 must be genuine
+            # 1. the commit certificate for height-1 must be genuine and
+            # prev_app_hash must match our own committed state root
+            try:
+                expected = val.app.store.committed_hash(payload.height - 1)
+            except KeyError:
+                expected = None
             ok, why = validate_payload_against_chain(
-                val.engine, payload, self._block_ids.get(payload.height - 1)
+                val.engine, payload, self._block_ids.get(payload.height - 1),
+                expected_prev_app_hash=expected,
             )
             if not ok:
                 return False, f"bad commit certificate: {why}"
@@ -171,6 +177,10 @@ class BFTNetwork:
                 last_commit = tuple(
                     sorted(prev.precommits, key=lambda v: v.validator)
                 )
+            try:
+                prev_app_hash = val.app.store.committed_hash(height - 1)
+            except KeyError:
+                prev_app_hash = b""
             return BlockPayload(
                 height=height,
                 time_ns=self._now_ns + self.block_interval_ns,
@@ -179,6 +189,7 @@ class BFTNetwork:
                 txs=tuple(proposal.block_txs),
                 proposer=val.address,
                 last_commit=last_commit,
+                prev_app_hash=prev_app_hash,
             )
 
         return propose

@@ -259,8 +259,13 @@ class TestNode:
     def _bft_validate(self, payload):
         from celestia_tpu.node.bft import validate_payload_against_chain
 
+        try:
+            expected = self.app.store.committed_hash(payload.height - 1)
+        except KeyError:
+            expected = None
         ok, why = validate_payload_against_chain(
-            self._bft, payload, self._bft_block_ids.get(payload.height - 1)
+            self._bft, payload, self._bft_block_ids.get(payload.height - 1),
+            expected_prev_app_hash=expected,
         )
         if not ok:
             return False, f"bad commit certificate: {why}"
@@ -282,6 +287,10 @@ class TestNode:
             last_commit = tuple(
                 sorted(prev.precommits, key=lambda v: v.validator)
             )
+        try:
+            prev_app_hash = self.app.store.committed_hash(height - 1)
+        except KeyError:
+            prev_app_hash = b""
         return BlockPayload(
             height=height,
             time_ns=self._now_ns + self.block_interval_ns,
@@ -290,6 +299,7 @@ class TestNode:
             txs=tuple(proposal.block_txs),
             proposer=self._validator_key.public_key().address(),
             last_commit=last_commit,
+            prev_app_hash=prev_app_hash,
         )
 
     def _bft_decide(self, decided) -> None:

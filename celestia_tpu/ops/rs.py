@@ -105,6 +105,305 @@ def extend_squares_batched(squares) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Device-side repair (rsmt2d.Repair on the MXU)
+#
+# Key observation: which axes become solvable in which order depends ONLY on
+# the boolean availability mask, never on share values.  So the host
+# simulates the peeling schedule on bools (microseconds), uploads the tiny
+# per-phase index tensors (known positions + update masks, ~KB), and the
+# device runs the entire data path: Lagrange decode-matrix construction in
+# the log domain, the GF(2) bit-lift, and the batched decode as int8 MXU
+# matmuls — the same arithmetic as the encode path, so it is bit-exact with
+# the host reference.  No share byte crosses the PCIe/ICI link between
+# phases.
+# ---------------------------------------------------------------------------
+
+def _gf_tables_dev():
+    # created per call, NOT cached: importing this module must not
+    # initialize a jax backend, and a cached array captured inside a
+    # traced scope would leak a tracer into later traces.  XLA folds
+    # the repeated constants, so per-call creation costs nothing.
+    return (
+        jnp.asarray(gf256.GF_EXP, dtype=jnp.int32),
+        jnp.asarray(gf256.GF_LOG, dtype=jnp.int32),
+    )
+
+
+def _gf_mul_dev(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Element-wise GF(256) multiply on device (log/exp gathers)."""
+    exp, log = _gf_tables_dev()
+    a = a.astype(jnp.int32)
+    b = b.astype(jnp.int32)
+    out = exp[(log[a] + log[b]) % 255]
+    return jnp.where((a == 0) | (b == 0), 0, out).astype(jnp.uint8)
+
+
+def _decode_matrices_dev(known: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Device port of gf256.decode_matrices_batch: known uint8[n, k]
+    (distinct points per row — guaranteed by the host scheduler) ->
+    D uint8[n, 2k, k]."""
+    exp, log = _gf_tables_dev()
+    src = known.astype(jnp.int32)  # [n, k]
+    dst = jnp.arange(2 * k, dtype=jnp.int32)
+    diff_ss = src[:, None, :] ^ src[:, :, None]  # [n, j, m]
+    diff_ss = diff_ss.at[:, jnp.arange(k), jnp.arange(k)].set(1)
+    denom_log = log[diff_ss].sum(axis=2) % 255  # [n, j]
+    diff_ds = dst[None, :, None] ^ src[:, None, :]  # [n, i, m]
+    zero_mask = diff_ds == 0
+    safe = jnp.where(zero_mask, 1, diff_ds)
+    log_all = log[safe]  # [n, i, m]
+    total_log = log_all.sum(axis=2)  # [n, i]
+    has_zero = zero_mask.any(axis=2)  # [n, i]
+    num_log = (total_log[:, :, None] - log_all) % 255  # [n, i, j]
+    lagrange = exp[(num_log - denom_log[:, None, :]) % 255]
+    return jnp.where(
+        has_zero[:, :, None], zero_mask.astype(jnp.uint8), lagrange
+    ).astype(jnp.uint8)
+
+
+def _bit_expand_dev(D: jnp.ndarray) -> jnp.ndarray:
+    """Device port of gf256.bit_expand_matrix, batched: uint8[n, m, c] ->
+    int8 0/1 [n, 8m, 8c]."""
+    n, m, c = D.shape
+    powers = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
+    prod = _gf_mul_dev(D[:, :, :, None], powers[None, None, None, :])
+    s_idx = jnp.arange(8, dtype=jnp.uint8)
+    bits = (prod[:, :, :, None, :] >> s_idx[None, None, None, :, None]) & 1
+    return bits.transpose(0, 1, 3, 2, 4).reshape(n, 8 * m, 8 * c).astype(jnp.int8)
+
+
+def _decode_axes_dev(
+    data: jnp.ndarray, known: jnp.ndarray, k: int, chunk: int
+) -> jnp.ndarray:
+    """Decode ALL 2k axes of one orientation: data uint8[2k, 2k, B]
+    (axis-major), known uint8[2k, k] -> decoded uint8[2k, 2k, B].
+    Chunked over axes to bound the D_bits working set."""
+    n2 = 2 * k
+    B = data.shape[2]
+    X = jnp.take_along_axis(data, known[:, :, None].astype(jnp.int32), axis=1)
+
+    def one_chunk(args):
+        Xc, knownc = args  # [chunk, k, B], [chunk, k]
+        D = _decode_matrices_dev(knownc, k)  # [chunk, 2k, k]
+        D_bits = _bit_expand_dev(D)  # [chunk, 16k, 8k]
+        X_bits = unpack_bits(Xc)  # [chunk, 8k, B]
+        out_bits = matmul_gf2(D_bits, X_bits)  # [chunk, 16k, B]
+        return pack_bits(out_bits)  # [chunk, 2k, B]
+
+    n_chunks = max(1, n2 // chunk)
+    chunk = n2 // n_chunks
+    Xr = X.reshape(n_chunks, chunk, k, B)
+    Kr = known.reshape(n_chunks, chunk, k)
+    decoded = jax.lax.map(one_chunk, (Xr, Kr))  # [n_chunks, chunk, 2k, B]
+    return decoded.reshape(n2, n2, B)
+
+
+def _repair_phases(
+    eds: jnp.ndarray,
+    row_known: jnp.ndarray,  # [P, 2k, k]
+    row_mask: jnp.ndarray,  # [P, 2k] bool
+    col_known: jnp.ndarray,
+    col_mask: jnp.ndarray,
+    k: int,
+    chunk: int,
+) -> jnp.ndarray:
+    """P peeling phases (rows then columns each), fully on device."""
+    P = row_known.shape[0]
+    for p in range(P):  # P is static: unrolled into one XLA program
+        decoded = _decode_axes_dev(eds, row_known[p], k, chunk)
+        eds = jnp.where(row_mask[p][:, None, None], decoded, eds)
+        edsT = eds.transpose(1, 0, 2)
+        decodedT = _decode_axes_dev(edsT, col_known[p], k, chunk)
+        edsT = jnp.where(col_mask[p][:, None, None], decodedT, edsT)
+        eds = edsT.transpose(1, 0, 2)
+    return eds
+
+
+def _repair_verify(
+    eds, avail, row_known, row_mask, col_known, col_mask, *, k: int,
+    chunk: int, with_roots: bool,
+):
+    """Phases + BOTH byzantine checks (+ axis roots) fused into ONE
+    device program — a repairing light/full node pays a single round trip
+    for everything except the (optional) bulk fetch of the square.
+
+    eds arrives with unavailable cells zeroed, so comparing the repaired
+    square against it AT AVAILABLE CELLS is exactly the provided-share
+    consistency check (rsmt2d ErrByzantine for shares the peeling
+    schedule overwrote)."""
+    repaired = _repair_phases(
+        eds, row_known, row_mask, col_known, col_mask, k=k, chunk=chunk
+    )
+    G = jnp.asarray(gf256.encode_matrix_bits(k))
+    recomputed = _extend(repaired[:k, :k], G)
+    mismatch = jnp.any(repaired != recomputed, axis=2)  # [2k, 2k] bool
+    provided_mismatch = avail & jnp.any(repaired != eds, axis=2)
+    if with_roots:
+        from celestia_tpu.ops import nmt as nmt_ops
+
+        roots = nmt_ops.eds_nmt_roots(repaired)  # [2, 2k, 90]
+    else:
+        roots = jnp.zeros((2, 2 * k, 90), dtype=jnp.uint8)
+    return repaired, mismatch, provided_mismatch, roots
+
+
+@lru_cache(maxsize=None)
+def _repair_verify_fn(k: int, phases: int, chunk: int, with_roots: bool):
+    return jax.jit(
+        partial(_repair_verify, k=k, chunk=chunk, with_roots=with_roots)
+    )
+
+
+def _simulate_schedule(avail: np.ndarray, k: int):
+    """Peel the availability mask on the host (bools only): returns the
+    per-phase (row_known, row_mask, col_known, col_mask) tensors the
+    device program consumes.  Raises if the mask cannot reconstruct."""
+    n2 = 2 * k
+    avail = avail.copy()
+    row_known, row_mask, col_known, col_mask = [], [], [], []
+
+    def plan(mask2d):
+        counts = mask2d.sum(axis=1)
+        solvable = (counts >= k) & (counts < n2)
+        # first k available positions per axis (arbitrary valid points for
+        # unsolvable axes — their results are masked out)
+        order = np.argsort(~mask2d, axis=1, kind="stable")
+        known = np.sort(order[:, :k], axis=1).astype(np.uint8)
+        known[~solvable] = np.arange(k, dtype=np.uint8)[None, :]
+        return known, solvable
+
+    while not avail.all():
+        rk, rm = plan(avail)
+        avail[rm] = True
+        ck, cm = plan(avail.T)
+        avail[:, cm] = True
+        if not (rm.any() or cm.any()):
+            raise ValueError(
+                "repair stalled: insufficient available cells to reconstruct"
+            )
+        row_known.append(rk)
+        row_mask.append(rm)
+        col_known.append(ck)
+        col_mask.append(cm)
+    if not row_known:  # nothing missing: zero phases
+        return None
+    return (
+        np.stack(row_known),
+        np.stack(row_mask),
+        np.stack(col_known),
+        np.stack(col_mask),
+    )
+
+
+def repair_square_device(
+    eds: np.ndarray,
+    available: np.ndarray,
+    row_roots: np.ndarray = None,
+    col_roots: np.ndarray = None,
+    breakdown: dict = None,
+    return_device: bool = False,
+) -> np.ndarray:
+    """rsmt2d.Repair on the accelerator (VERDICT r2 #6 / BASELINE #4).
+
+    Same contract as :func:`repair_square` — reconstruct, then prove the
+    result is the unique codeword matching everything the caller provided
+    (ByzantineError otherwise) and the committed DAH roots when given —
+    but the decode matmuls, BOTH byzantine checks (codeword consistency
+    AND provided-share agreement) and the NMT roots all run as ONE fused
+    device program; the host only peels the boolean mask and ships index
+    tensors, then fetches the small verdicts (mismatch matrices + roots).
+    The bulk square is fetched only for the host return value — pass
+    return_device=True to keep it on device (DAS servers read shares
+    straight from device memory) with no loss of verification.
+
+    breakdown (optional dict) receives schedule/upload/compute/fetch
+    millisecond attributions."""
+    import time as _t
+
+    provided = np.asarray(eds, dtype=np.uint8)
+    avail = np.asarray(available, dtype=bool)
+    n2 = provided.shape[0]
+    k = n2 // 2
+    if provided.shape[:2] != (n2, n2) or avail.shape != (n2, n2):
+        raise ValueError("eds must be (2k, 2k, B) with matching availability mask")
+    masked = np.where(avail[:, :, None], provided, 0).astype(np.uint8)
+
+    t0 = _t.time()
+    schedule = _simulate_schedule(avail, k)
+    if schedule is None:
+        P = 0
+        rk = np.zeros((0, n2, k), dtype=np.uint8)
+        rm = np.zeros((0, n2), dtype=bool)
+        ck, cm = rk.copy(), rm.copy()
+    else:
+        rk, rm, ck, cm = schedule
+        P = rk.shape[0]
+    chunk = min(n2, max(1, 2048 // k))  # ~bounded D_bits working set
+    with_roots = row_roots is not None or col_roots is not None
+    t1 = _t.time()
+    masked_dev = jax.device_put(jnp.asarray(masked))
+    masked_dev.block_until_ready()
+    t2 = _t.time()
+    fn = _repair_verify_fn(k, P, chunk, with_roots)
+    repaired_dev, mismatch_dev, provided_mismatch_dev, roots_dev = fn(
+        masked_dev, jnp.asarray(avail),
+        jnp.asarray(rk), jnp.asarray(rm),
+        jnp.asarray(ck), jnp.asarray(cm),
+    )
+    jax.block_until_ready(repaired_dev)
+    t3 = _t.time()
+    mismatch_axes = np.asarray(mismatch_dev)
+    provided_mismatch = np.asarray(provided_mismatch_dev)
+    roots = np.asarray(roots_dev) if with_roots else None
+    t4 = _t.time()
+    if breakdown is not None:
+        breakdown.update(
+            schedule_ms=(t1 - t0) * 1000.0,
+            upload_ms=(t2 - t1) * 1000.0,
+            compute_ms=(t3 - t2) * 1000.0,
+            verdict_fetch_ms=(t4 - t3) * 1000.0,
+        )
+    if mismatch_axes.any():
+        bad = np.nonzero(mismatch_axes)
+        raise ByzantineError(
+            f"inconsistent erasure coding at cells {list(zip(*bad))[:8]}"
+        )
+    if provided_mismatch.any():
+        bad = np.nonzero(provided_mismatch)
+        raise ByzantineError(
+            f"provided shares disagree with the reconstructed codeword at "
+            f"cells {list(zip(*bad))[:8]}"
+        )
+    if with_roots:
+        for name, axis_roots, got in (
+            ("row", row_roots, roots[0]),
+            ("col", col_roots, roots[1]),
+        ):
+            if axis_roots is None:
+                continue
+            axis_roots = np.asarray(axis_roots, dtype=np.uint8)
+            if axis_roots.shape != got.shape:
+                raise ValueError(
+                    f"{name}_roots must be {got.shape}, got {axis_roots.shape}"
+                )
+            bad = np.nonzero((axis_roots != got).any(axis=1))[0]
+            if len(bad):
+                raise ByzantineError(
+                    f"reconstructed {name} axes {bad.tolist()[:8]} do not "
+                    f"match the committed NMT roots"
+                )
+    if return_device:
+        # all verification already ran on device; the caller keeps the
+        # square in device memory (no bulk fetch)
+        return repaired_dev
+    t5 = _t.time()
+    repaired = np.asarray(repaired_dev)
+    if breakdown is not None:
+        breakdown["bulk_fetch_ms"] = (_t.time() - t5) * 1000.0
+    return repaired
+
+
+# ---------------------------------------------------------------------------
 # Repair (rsmt2d.Repair parity): iterative row/column reconstruction
 # ---------------------------------------------------------------------------
 

@@ -73,7 +73,7 @@ from celestia_tpu.utils.telemetry import Telemetry
 STORE_NAMES = [
     "auth", "bank", "staking", "params", "blob", "upgrade", "blobstream",
     "mint", "gov", "meta", "feegrant", "authz", "distribution", "slashing",
-    "evidence",
+    "evidence", "ibc",
 ]
 
 _APP_VERSION_KEY = b"app_version"
@@ -177,7 +177,8 @@ class App:
         from celestia_tpu.state.modules.ibc import IBCStack
 
         self.ibc = IBCStack(
-            name=self.chain_id, bank=self.bank, filtered=True, app=self
+            name=self.chain_id, bank=self.bank, filtered=True, app=self,
+            store=self.store.store("ibc"),
         )
 
     # ------------------------------------------------------------------
@@ -391,10 +392,24 @@ class App:
         t0 = _time.time()
         try:
             kept = self._filter_txs(txs)
+            t1 = _time.time()
             square, block_txs, wrappers = build_square(
                 kept, self.max_effective_square_size()
             )
+            t2 = _time.time()
             eds, dah = dah_mod.extend_block(square)
+            t3 = _time.time()
+            # per-phase budget (SURVEY §7 hard part c): host tx filtering,
+            # host square assembly, device extension incl. transfer —
+            # telemetry + last_prepare_breakdown let the bench isolate
+            # the tunnel RTT from real host-side overhead
+            self.last_prepare_breakdown = {
+                "filter_ms": (t1 - t0) * 1000.0,
+                "build_ms": (t2 - t1) * 1000.0,
+                "extend_ms": (t3 - t2) * 1000.0,
+            }
+            for name, v in self.last_prepare_breakdown.items():
+                self.telemetry.observe(f"prepare_proposal.{name}", v)
             return PreparedProposal(
                 block_txs=block_txs,
                 square_size=square.size,

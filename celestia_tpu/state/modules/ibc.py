@@ -52,9 +52,17 @@ class Channel:
 
 
 class ChannelKeeper:
-    """ICS-4 surface: channels, sequences, commitments, acks."""
+    """ICS-4 surface: channels, sequences, commitments, acks.
 
-    def __init__(self):
+    With a KVStore attached (the app's "ibc" substore), every packet
+    commitment, acknowledgement and receive receipt is ALSO written to
+    merkleized state — which is what makes them PROVABLE to a
+    counterparty light client (state.merkle proofs over the committed
+    app hash; modules/ibc_client.py key layout).  Without a store the
+    keeper works dict-only (standalone test stacks)."""
+
+    def __init__(self, store=None):
+        self.store = store
         self.channels: Dict[str, Channel] = {}
         self._next_seq: Dict[str, int] = {}
         self.commitments: Dict[Tuple[str, int], bytes] = {}
@@ -63,6 +71,39 @@ class ChannelKeeper:
         # caller never sees, so the transport surfaces them here)
         self.sent: List[Tuple[Packet, int]] = []
         self._timed_out: set = set()
+        self._received: set = set()
+
+    def _skeys(self):
+        from celestia_tpu.state.modules import ibc_client as keys
+
+        return keys
+
+    def rehydrate(self) -> None:
+        """Rebuild the in-memory guards from the merkleized store after a
+        snapshot/disk restore: receipts (replay protection), commitments
+        (ack/timeout claims), channels, send sequences and timed-out
+        marks all survive a restart because they were mirrored to state —
+        without this, a restored node would accept replays and refuse
+        legitimate acks."""
+        if self.store is None:
+            return
+        for k, v in self.store.iterate():
+            parts = k.decode().split("/")
+            if parts[0] == "channels" and len(parts) == 2:
+                d = json.loads(v)
+                self.channels[parts[1]] = Channel(
+                    parts[1], d["port"], d["counterparty_channel"],
+                    d["counterparty_port"], d["state"],
+                )
+                self._next_seq.setdefault(parts[1], 1)
+            elif parts[0] == "nextseq" and len(parts) == 2:
+                self._next_seq[parts[1]] = int.from_bytes(v, "big")
+            elif parts[0] == "commitments" and len(parts) == 3:
+                self.commitments[(parts[1], int(parts[2]))] = v
+            elif parts[0] == "receipts" and len(parts) == 3:
+                self._received.add((parts[1], int(parts[2])))
+            elif parts[0] == "timedout" and len(parts) == 3:
+                self._timed_out.add((parts[1], int(parts[2])))
 
     def open_channel(
         self, channel_id: str, counterparty_channel: str,
@@ -71,6 +112,19 @@ class ChannelKeeper:
         ch = Channel(channel_id, port, counterparty_channel, counterparty_port)
         self.channels[channel_id] = ch
         self._next_seq[channel_id] = 1
+        if self.store is not None:
+            keys = self._skeys()
+            self.store.set(
+                keys.channel_key(channel_id),
+                json.dumps(
+                    {
+                        "port": port,
+                        "counterparty_channel": counterparty_channel,
+                        "counterparty_port": counterparty_port,
+                        "state": ch.state,
+                    }
+                ).encode(),
+            )
         return ch
 
     def send_packet(self, channel_id: str, data: bytes) -> Tuple[Packet, int]:
@@ -86,12 +140,42 @@ class ChannelKeeper:
             dest_channel=ch.counterparty_channel,
             data=data,
         )
-        self.commitments[(channel_id, seq)] = hashlib.sha256(data).digest()
+        commitment = hashlib.sha256(data).digest()
+        self.commitments[(channel_id, seq)] = commitment
+        if self.store is not None:
+            self.store.set(
+                self._skeys().commitment_key(channel_id, seq), commitment
+            )
+            self.store.set(
+                f"nextseq/{channel_id}".encode(),
+                self._next_seq[channel_id].to_bytes(8, "big"),
+            )
         self.sent.append((packet, seq))
         return packet, seq
 
     def write_ack(self, channel_id: str, seq: int, ack: Acknowledgement) -> None:
         self.acks[(channel_id, seq)] = ack
+        if self.store is not None:
+            keys = self._skeys()
+            self.store.set(
+                keys.ack_key(channel_id, seq),
+                hashlib.sha256(keys.ack_bytes(ack)).digest(),
+            )
+
+    def write_receipt(self, channel_id: str, seq: int) -> None:
+        """Replay guard: one receive per (channel, seq), provable."""
+        if (channel_id, seq) in self._received:
+            raise ValueError(
+                f"packet {channel_id}#{seq} was already received"
+            )
+        self._received.add((channel_id, seq))
+        if self.store is not None:
+            self.store.set(
+                self._skeys().receipt_key(channel_id, seq), b"\x01"
+            )
+
+    def has_receipt(self, channel_id: str, seq: int) -> bool:
+        return (channel_id, seq) in self._received
 
     def claim_commitment(self, channel_id: str, seq: int, data: bytes) -> None:
         """Check-and-delete: the stored commitment must exist and match the
@@ -109,11 +193,15 @@ class ChannelKeeper:
         if stored != hashlib.sha256(data).digest():
             raise ValueError(f"commitment mismatch for packet {channel_id}#{seq}")
         del self.commitments[key]
+        if self.store is not None:
+            self.store.delete(self._skeys().commitment_key(channel_id, seq))
 
     # sequences whose timeout was processed: a late delivery must refuse
     # (the source already refunded)
     def mark_timed_out(self, channel_id: str, seq: int) -> None:
         self._timed_out.add((channel_id, seq))
+        if self.store is not None:
+            self.store.set(f"timedout/{channel_id}/{seq}".encode(), b"\x01")
 
     def is_timed_out(self, channel_id: str, seq: int) -> bool:
         return (channel_id, seq) in self._timed_out
@@ -488,12 +576,20 @@ class IBCStack:
 
     name: str
     bank: object
-    channels: ChannelKeeper = field(default_factory=ChannelKeeper)
+    channels: ChannelKeeper = None
     filtered: bool = False
     forwarding: bool = True
     app: object = None  # the state-machine App (enables the ICA host)
+    store: object = None  # the app's "ibc" KVStore (provable commitments)
 
     def __post_init__(self):
+        if self.channels is None:
+            self.channels = ChannelKeeper(store=self.store)
+            # a restored node's guards come back from merkleized state
+            self.channels.rehydrate()
+        from celestia_tpu.state.modules.ibc_client import ConnectionKeeper
+
+        self.connections = ConnectionKeeper()
         transfer = TransferModule(self.bank, self.channels, self.name)
         module = TokenFilterMiddleware(transfer) if self.filtered else transfer
         if self.forwarding:
@@ -547,3 +643,152 @@ class Relayer:
         dst = self.b if src is self.a else self.a
         dst.channels.mark_timed_out(packet.dest_channel, seq)
         src.app_module_for(packet).on_timeout_packet(packet, seq)
+
+
+def recv_packet_verified(
+    stack: IBCStack, packet: Packet, seq: int, proof: dict, proof_height: int
+) -> Acknowledgement:
+    """Proof-gated receive (ibc-go core RecvPacket): before ANY app
+    callback runs, the packet must be proven committed on the
+    counterparty — a merkle membership proof of
+    commitments/{source_channel}/{seq} == sha256(packet.data) in the
+    counterparty's "ibc" store, verified against the light client bound
+    to the destination channel.  A forged, tampered or replayed packet
+    never reaches the transfer module.  Raises on verification failure
+    (the relayer is misbehaving; there is nothing to ack)."""
+    from celestia_tpu.state.modules.ibc_client import (
+        ClientError,
+        commitment_key,
+    )
+
+    client = stack.connections.client_for_channel(packet.dest_channel)
+    if client is None:
+        raise ClientError(
+            f"channel {packet.dest_channel} is not bound to a client"
+        )
+    # the packet's routing must match the channel REGISTRY, not the
+    # relayer's claims: the proven commitment key is scoped to the source
+    # channel only, so without this check one committed packet could be
+    # delivered on every destination channel bound to the same client
+    # (cross-channel replay; ibc-go checks Counterparty.ChannelId in
+    # RecvPacket the same way)
+    ch = stack.channels.channels.get(packet.dest_channel)
+    if ch is None or ch.state != "OPEN":
+        raise ClientError(f"channel {packet.dest_channel} is not open")
+    if (
+        ch.counterparty_channel != packet.source_channel
+        or ch.counterparty_port != packet.source_port
+        or ch.port != packet.dest_port
+    ):
+        raise ClientError(
+            "packet routing does not match the channel's counterparty"
+        )
+    if stack.channels.has_receipt(packet.dest_channel, seq):
+        raise ClientError(f"packet {packet.dest_channel}#{seq} already received")
+    client.verify_membership(
+        proof_height,
+        commitment_key(packet.source_channel, seq),
+        hashlib.sha256(packet.data).digest(),
+        proof,
+    )
+    stack.channels.write_receipt(packet.dest_channel, seq)
+    ack = stack.on_recv_packet(packet)
+    stack.channels.write_ack(packet.dest_channel, seq, ack)
+    return ack
+
+
+def ack_packet_verified(
+    stack: IBCStack,
+    packet: Packet,
+    seq: int,
+    ack: Acknowledgement,
+    proof: dict,
+    proof_height: int,
+) -> None:
+    """Proof-gated acknowledgement (ibc-go core AcknowledgePacket): the
+    claimed ack must be proven written on the counterparty before the
+    send side acts on it — a lying relayer cannot trigger a refund (error
+    ack) or suppress one (forged success)."""
+    from celestia_tpu.state.modules.ibc_client import (
+        ClientError,
+        ack_bytes,
+        ack_key,
+    )
+
+    client = stack.connections.client_for_channel(packet.source_channel)
+    if client is None:
+        raise ClientError(
+            f"channel {packet.source_channel} is not bound to a client"
+        )
+    # pin the ack's location to OUR channel's registered counterparty —
+    # a relayer-chosen dest_channel could otherwise prove some OTHER
+    # channel's success ack and suppress this packet's refund
+    ch = stack.channels.channels.get(packet.source_channel)
+    if ch is None:
+        raise ClientError(f"unknown channel {packet.source_channel}")
+    if (
+        ch.counterparty_channel != packet.dest_channel
+        or ch.counterparty_port != packet.dest_port
+        or ch.port != packet.source_port
+    ):
+        raise ClientError(
+            "ack routing does not match the channel's counterparty"
+        )
+    client.verify_membership(
+        proof_height,
+        ack_key(packet.dest_channel, seq),
+        hashlib.sha256(ack_bytes(ack)).digest(),
+        proof,
+    )
+    stack.app_module_for(packet).on_acknowledgement(packet, seq, ack)
+
+
+class SecureRelayer:
+    """An UNTRUSTED relayer between two App-backed chains: it moves
+    (header, certificate) pairs to update clients and (packet, proof)
+    pairs to deliver — every byte it carries is verified by the receiving
+    chain.  chain handles must expose .app (the App) and .header_and_cert
+    (height -> (header_fields, precommit wires)); see
+    tests/test_ibc_light_client.py for the BFT-network-backed harness."""
+
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def _other(self, chain):
+        return self.b if chain is self.a else self.a
+
+    def update_client(self, dst_chain, src_chain, height: int) -> int:
+        header, cert = src_chain.header_and_cert(height)
+        client = dst_chain.client_of_counterparty
+        return client.update(header, cert)
+
+    def relay(self, src_chain, packet: Packet, seq: int) -> Acknowledgement:
+        """Full verified lifecycle: commit the send, prove the commitment
+        to the destination, receive, commit the ack, prove it back.
+
+        Height arithmetic (Tendermint convention): state written before
+        block H is committed in app_hash(H); the header at H+1 carries
+        prev_app_hash = app_hash(H); so a proof generated at H verifies
+        against the destination client's consensus state at H+1."""
+        from celestia_tpu.state.modules.ibc_client import ack_key, commitment_key
+
+        dst_chain = self._other(src_chain)
+        # 1. commit the send, then the header that proves it
+        src_chain.commit_block()  # height H: includes the commitment
+        src_chain.commit_block()  # height H+1: header proves app_hash(H)
+        h = src_chain.app.store.last_height - 1
+        self.update_client(dst_chain, src_chain, h + 1)
+        proof = src_chain.app.store.prove(
+            "ibc", commitment_key(packet.source_channel, seq), h
+        )
+        ack = recv_packet_verified(dst_chain.stack, packet, seq, proof, h + 1)
+        # 2. destination commits the ack, then proves it back
+        dst_chain.commit_block()
+        dst_chain.commit_block()
+        d = dst_chain.app.store.last_height - 1
+        self.update_client(src_chain, dst_chain, d + 1)
+        ack_proof = dst_chain.app.store.prove(
+            "ibc", ack_key(packet.dest_channel, seq), d
+        )
+        ack_packet_verified(src_chain.stack, packet, seq, ack, ack_proof, d + 1)
+        return ack

@@ -1,0 +1,255 @@
+"""IBC light-client layer: trustless packet verification (VERDICT r2 #4).
+
+The round-2 stack moved packets on relayer honesty.  This module adds the
+trust machinery the reference gets from ibc-go core + 07-tendermint
+clients (/root/reference/app/app.go:339-358):
+
+- ``LightClient`` tracks a counterparty chain's validator set and a map
+  height -> ``ConsensusState`` (state root + time).  It advances ONLY on
+  a header whose BFT commit certificate verifies: >= 2/3 of the tracked
+  power signed precommits over the header's block id
+  (node/bft.py vote signatures), and the block id commits to
+  ``prev_app_hash`` — so the certificate proves the counterparty's state
+  root exactly the way a Tendermint header's AppHash is proven.
+- ``Connection`` binds channels to a client (the ICS-3 role, condensed:
+  the handshake's proof obligations are the membership checks below).
+- Verified packet receive / acknowledgement: the relayer presents a
+  merkle membership proof of the packet commitment (or ack) in the
+  counterparty's "ibc" store at a proven height; the proof is checked
+  against the light client's consensus state with
+  state.merkle.verify_query_proof — the relayer is untrusted end to end.
+
+Height convention (Tendermint's): the consensus state recorded at header
+height H carries the state root app_hash(H-1); a proof generated against
+the store committed at height G therefore verifies with the consensus
+state at G+1.
+
+Limitation (documented, round-3 scope): the tracked validator set is
+fixed at client creation — valset rotation needs the next-valset hash
+committed in the block id, which the payload does not carry yet.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from celestia_tpu.node.bft import (
+    PRECOMMIT,
+    Vote,
+    block_id_of,
+    vote_sign_bytes,
+)
+from celestia_tpu.state import merkle
+from celestia_tpu.utils.secp256k1 import PublicKey
+
+
+class ClientError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class ConsensusState:
+    root: bytes  # the counterparty app hash proofs verify against
+    time_ns: int
+
+
+class LightClient:
+    """07-tendermint analogue over the BFT engine's vote format."""
+
+    def __init__(
+        self,
+        client_id: str,
+        chain_id: str,
+        validators: Dict[bytes, int],  # operator address -> power
+        pubkeys: Dict[bytes, bytes],  # operator address -> 33B compressed
+    ):
+        if not validators:
+            raise ClientError("empty validator set")
+        self.client_id = client_id
+        self.chain_id = chain_id
+        self.validators = dict(validators)
+        self.pubkeys = dict(pubkeys)
+        self.total_power = sum(validators.values())
+        self.consensus_states: Dict[int, ConsensusState] = {}
+        self.latest_height = 0
+        self.frozen = False
+
+    # -- header verification -------------------------------------------
+
+    def update(self, header: dict, precommits: List[dict]) -> int:
+        """Verify a (header, commit certificate) pair and record the
+        consensus state it proves.  header = BlockPayload.header_fields()
+        — the block-id preimage without txs; precommits = Vote wire
+        dicts.  Returns the header height.  The caller (relayer) is
+        untrusted: everything is checked against the tracked valset."""
+        if self.frozen:
+            raise ClientError(f"client {self.client_id} is frozen")
+        height = int(header["height"])
+        prev_app_hash = bytes.fromhex(header["prev_app_hash"])
+        block_id = block_id_of(
+            height,
+            int(header["time_ns"]),
+            int(header["square_size"]),
+            bytes.fromhex(header["data_root"]),
+            bytes.fromhex(header["proposer"]),
+            bytes.fromhex(header["last_commit_digest"]),
+            prev_app_hash,
+        )
+        votes = [Vote.from_wire(v) for v in precommits]
+        if not votes:
+            raise ClientError("empty certificate: below 2/3 power")
+        rounds = {v.round for v in votes}
+        if len(rounds) != 1:
+            raise ClientError("commit certificate mixes rounds")
+        seen = set()
+        power = 0
+        for v in votes:
+            if v.vtype != PRECOMMIT or v.height != height:
+                raise ClientError("certificate vote is not for this header")
+            if v.block_id != block_id:
+                raise ClientError("certificate vote is for a different block")
+            if v.validator in seen:
+                raise ClientError("duplicate validator in certificate")
+            seen.add(v.validator)
+            vp = self.validators.get(v.validator)
+            pk = self.pubkeys.get(v.validator)
+            if not vp or pk is None:
+                raise ClientError("unknown validator in certificate")
+            digest = vote_sign_bytes(
+                self.chain_id, v.height, v.round, v.vtype, v.block_id
+            )
+            if not PublicKey.from_compressed(pk).verify(digest, v.signature):
+                raise ClientError("certificate signature does not verify")
+            power += vp
+        if power * 3 < self.total_power * 2:
+            raise ClientError(
+                f"certificate power {power} below 2/3 of {self.total_power}"
+            )
+        # Tendermint semantics: the header at H proves app_hash(H-1);
+        # record it as the consensus state AT H
+        self.consensus_states[height] = ConsensusState(
+            root=prev_app_hash, time_ns=int(header["time_ns"])
+        )
+        self.latest_height = max(self.latest_height, height)
+        return height
+
+    # -- membership verification ---------------------------------------
+
+    def verify_membership(
+        self, proof_height: int, key: bytes, value: bytes, proof: dict
+    ) -> None:
+        """Raise ClientError unless ``proof`` shows ("ibc", key) == value
+        in the counterparty state the consensus state at proof_height
+        commits to.  The proof's own claimed key/value/store are checked
+        AGAINST THE CALLER'S expectation — a relayer substituting a proof
+        of some other key fails here."""
+        cs = self.consensus_states.get(proof_height)
+        if cs is None:
+            raise ClientError(
+                f"no consensus state at height {proof_height} "
+                f"(client {self.client_id})"
+            )
+        if proof.get("store") != "ibc":
+            raise ClientError("proof is not for the ibc store")
+        if bytes.fromhex(proof["key"]) != key:
+            raise ClientError("proof key does not match the packet")
+        if proof["value"] is None or bytes.fromhex(proof["value"]) != value:
+            raise ClientError("proof value does not match the packet")
+        if not merkle.verify_query_proof(proof, cs.root):
+            raise ClientError(
+                "membership proof does not verify against the consensus state"
+            )
+
+    def verify_non_membership(
+        self, proof_height: int, key: bytes, proof: dict
+    ) -> None:
+        """Absence proof (timeouts: the counterparty never wrote a
+        receipt for the packet)."""
+        cs = self.consensus_states.get(proof_height)
+        if cs is None:
+            raise ClientError(f"no consensus state at height {proof_height}")
+        if proof.get("store") != "ibc":
+            raise ClientError("proof is not for the ibc store")
+        if bytes.fromhex(proof["key"]) != key:
+            raise ClientError("proof key does not match")
+        if proof["value"] is not None:
+            raise ClientError("expected an absence proof")
+        if not merkle.verify_query_proof(proof, cs.root):
+            raise ClientError(
+                "absence proof does not verify against the consensus state"
+            )
+
+
+@dataclass
+class Connection:
+    """ICS-3 condensed: a named binding of channels to a light client."""
+
+    connection_id: str
+    client: LightClient
+    counterparty_connection: str = ""
+
+
+class ConnectionKeeper:
+    def __init__(self):
+        self.clients: Dict[str, LightClient] = {}
+        self.connections: Dict[str, Connection] = {}
+        # channel_id -> connection_id: which client secures which channel
+        self.channel_bindings: Dict[str, str] = {}
+
+    def create_client(self, client: LightClient) -> None:
+        if client.client_id in self.clients:
+            raise ClientError(f"client {client.client_id} exists")
+        self.clients[client.client_id] = client
+
+    def open_connection(
+        self, connection_id: str, client_id: str,
+        counterparty_connection: str = "",
+    ) -> Connection:
+        client = self.clients.get(client_id)
+        if client is None:
+            raise ClientError(f"unknown client {client_id}")
+        conn = Connection(connection_id, client, counterparty_connection)
+        self.connections[connection_id] = conn
+        return conn
+
+    def bind_channel(self, channel_id: str, connection_id: str) -> None:
+        if connection_id not in self.connections:
+            raise ClientError(f"unknown connection {connection_id}")
+        self.channel_bindings[channel_id] = connection_id
+
+    def client_for_channel(self, channel_id: str) -> Optional[LightClient]:
+        conn_id = self.channel_bindings.get(channel_id)
+        if conn_id is None:
+            return None
+        return self.connections[conn_id].client
+
+
+# -- store key layout (what proofs point at) ------------------------------
+
+
+def commitment_key(channel_id: str, seq: int) -> bytes:
+    return f"commitments/{channel_id}/{seq}".encode()
+
+
+def ack_key(channel_id: str, seq: int) -> bytes:
+    return f"acks/{channel_id}/{seq}".encode()
+
+
+def receipt_key(channel_id: str, seq: int) -> bytes:
+    return f"receipts/{channel_id}/{seq}".encode()
+
+
+def channel_key(channel_id: str) -> bytes:
+    return f"channels/{channel_id}".encode()
+
+
+def ack_bytes(ack) -> bytes:
+    """Canonical acknowledgement encoding (what the ack commitment
+    hashes)."""
+    return json.dumps(
+        {"success": bool(ack.success), "error": ack.error or ""},
+        sort_keys=True,
+    ).encode()

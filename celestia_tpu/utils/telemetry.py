@@ -28,6 +28,10 @@ class Telemetry:
     def measure_since(self, name: str, t0: float) -> None:
         self.timings[name].append(time.time() - t0)
 
+    def observe(self, name: str, value_ms: float) -> None:
+        """Record an externally-measured duration (milliseconds)."""
+        self.timings[name].append(value_ms / 1000.0)
+
     def summary(self) -> dict:
         out: dict = {"counters": dict(self.counters), "gauges": dict(self.gauges)}
         for name, vals in self.timings.items():

@@ -51,6 +51,28 @@ def test_sharded_batched_data_axis():
         assert np.array_equal(cc_b[i], roots[1])
 
 
+def test_sharded_full_size_128():
+    """BASELINE config #5 / VERDICT r2 #9: the production 128x128 square
+    through shard_map on the 8-device mesh, bit-identical to the
+    unsharded pipeline.  k=128 exercises the real tile shapes (the 8k/R
+    dynamic slice, psum_scatter tiling) that k=8 cannot."""
+    mesh = sharded.make_mesh(data=1, row=8)
+    rng = np.random.default_rng(128)
+    k = 128
+    sq = rng.integers(0, 256, (k, k, 512), dtype=np.uint8)
+    eds, rr, cc, droot = sharded.extend_and_roots_sharded(sq, mesh)
+    eds_ref = np.asarray(rs.extend_square(sq))
+    assert np.array_equal(eds, eds_ref)
+    roots = _roots_ref(eds_ref)
+    assert np.array_equal(rr, roots[0])
+    assert np.array_equal(cc, roots[1])
+    want = dah_mod.DataAvailabilityHeader.compute_hash(
+        [roots[0][i].tobytes() for i in range(2 * k)],
+        [roots[1][i].tobytes() for i in range(2 * k)],
+    )
+    assert droot.tobytes() == want
+
+
 def test_mesh_validation():
     with pytest.raises(ValueError):
         sharded.make_mesh(jax.devices(), data=3, row=4)

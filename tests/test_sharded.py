@@ -16,12 +16,12 @@ import subprocess
 import sys
 
 
-def test_sharded_suite_in_fresh_process():
+def _run_isolated(select: str) -> None:
     inner = os.path.join(os.path.dirname(__file__), "_sharded_isolated.py")
     env = dict(os.environ)
     env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
     proc = subprocess.run(
-        [sys.executable, "-m", "pytest", "-q", inner],
+        [sys.executable, "-m", "pytest", "-q", inner, "-k", select],
         capture_output=True,
         text=True,
         timeout=3000,
@@ -33,3 +33,14 @@ def test_sharded_suite_in_fresh_process():
         f"isolated sharded suite failed (rc={proc.returncode}):\n"
         f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
     )
+
+
+def test_sharded_suite_in_fresh_process():
+    _run_isolated("not full_size")
+
+
+def test_sharded_full_size_in_fresh_process():
+    # the 128x128 shard_map program is big enough that compiling it AFTER
+    # the small-k programs in one process trips the same late-compile
+    # jaxlib fragility the wrapper exists for — it gets its own child
+    _run_isolated("full_size")

@@ -189,6 +189,62 @@ def _repair_ms(k: int):
     }
 
 
+def _amortized_repair_device_ms(k: int, r_lo: int = 3, r_hi: int = 9):
+    """Marginal per-repair device time (decode phases + re-extension
+    check + axis roots) via dependent-chain subtraction — the tunnel's
+    fixed RTT cancels, leaving what a locally-attached chip pays."""
+    import jax
+    import jax.numpy as jnp
+
+    from celestia_tpu.ops import rs
+
+    rng = np.random.default_rng(7)
+    sq = rng.integers(0, 256, (k, k, 512), dtype=np.uint8)
+    eds = np.asarray(rs.extend_square(sq))
+    avail = rng.random((2 * k, 2 * k)) >= 0.25
+    masked = np.where(avail[:, :, None], eds, 0).astype(np.uint8)
+    rk, rm, ck, cm = rs._simulate_schedule(avail, k)
+    chunk = min(2 * k, max(1, 8192 // k))
+    G = jnp.asarray(__import__("celestia_tpu.ops.gf256", fromlist=["x"]).encode_matrix_bits(k))
+    from celestia_tpu.ops import nmt as nmt_ops
+
+    rkj, rmj = jnp.asarray(rk), jnp.asarray(rm)
+    ckj, cmj = jnp.asarray(ck), jnp.asarray(cm)
+
+    def chain(r):
+        @jax.jit
+        def f(x):
+            def body(i, x):
+                rep = rs._repair_phases(
+                    x, rkj, rmj, ckj, cmj, k=k, chunk=chunk
+                )
+                rec = rs._extend(rep[:k, :k], G)
+                roots = nmt_ops.eds_nmt_roots(rep)
+                # fold verdict bytes back in: keeps the chain dependent
+                return rep.at[0, 0, 0].set(
+                    rec[0, 0, 0] ^ roots[0, 0, 0]
+                )
+
+            return jax.lax.fori_loop(0, r, body, x)
+
+        return f
+
+    x = jax.device_put(jnp.asarray(masked))
+    f_lo, f_hi = chain(r_lo), chain(r_hi)
+    np.asarray(f_lo(x)).ravel()[0]
+    np.asarray(f_hi(x)).ravel()[0]
+    reps = []
+    for _ in range(3):
+        t0 = time.time()
+        np.asarray(f_lo(x)).ravel()[0]
+        t_lo = time.time() - t0
+        t0 = time.time()
+        np.asarray(f_hi(x)).ravel()[0]
+        t_hi = time.time() - t0
+        reps.append((t_hi - t_lo) / (r_hi - r_lo) * 1000.0)
+    return max(float(np.median(reps)), 1e-3)
+
+
 def _make_pfb_node_and_txs(
     n_tx: int, blob_bytes: int, seed: int, max_square: int, key_prefix: bytes
 ):
@@ -327,6 +383,12 @@ def main():
             + repair_bd.get("compute_ms", 0.0)
             + repair_bd.get("verdict_fetch_ms", 0.0),
             1,
+        )
+        # RTT-free device figure: chained-iteration marginal cost of the
+        # full verified repair program (decode + re-extension + roots) —
+        # what the <500 ms BASELINE #4 budget means on attached hardware
+        extras[f"repair_{k}_device_amortized_ms"] = round(
+            _amortized_repair_device_ms(k), 1
         )
     except Exception as e:
         extras["repair_error"] = repr(e)[:200]

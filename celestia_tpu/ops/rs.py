@@ -129,15 +129,6 @@ def _gf_tables_dev():
     )
 
 
-def _gf_mul_dev(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Element-wise GF(256) multiply on device (log/exp gathers)."""
-    exp, log = _gf_tables_dev()
-    a = a.astype(jnp.int32)
-    b = b.astype(jnp.int32)
-    out = exp[(log[a] + log[b]) % 255]
-    return jnp.where((a == 0) | (b == 0), 0, out).astype(jnp.uint8)
-
-
 def _decode_matrices_dev(known: jnp.ndarray, k: int) -> jnp.ndarray:
     """Device port of gf256.decode_matrices_batch: known uint8[n, k]
     (distinct points per row — guaranteed by the host scheduler) ->
@@ -161,15 +152,31 @@ def _decode_matrices_dev(known: jnp.ndarray, k: int) -> jnp.ndarray:
     ).astype(jnp.uint8)
 
 
+@lru_cache(maxsize=1)
+def _bit_basis():
+    """B[u, s, t] = bit s of gf_mul(2^u, 2^t) — the GF(2) lift is LINEAR
+    in the operand's bits: M(a)[s,t] = XOR_u a_u * B[u,s,t].  Expanding a
+    matrix therefore needs no table gathers (slow on TPU), just one tiny
+    contraction over u against this 8x8x8 constant."""
+    powers = np.uint8(1) << np.arange(8, dtype=np.uint8)
+    prod = gf256.gf_mul(powers[:, None], powers[None, :])  # [u, t]
+    s = np.arange(8, dtype=np.uint8)
+    return ((prod[:, None, :] >> s[None, :, None]) & 1).astype(np.int8)
+
+
 def _bit_expand_dev(D: jnp.ndarray) -> jnp.ndarray:
     """Device port of gf256.bit_expand_matrix, batched: uint8[n, m, c] ->
-    int8 0/1 [n, 8m, 8c]."""
+    int8 0/1 [n, 8m, 8c].  Gather-free: unpack D's bits, contract with
+    the constant bit basis, mod 2."""
     n, m, c = D.shape
-    powers = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
-    prod = _gf_mul_dev(D[:, :, :, None], powers[None, None, None, :])
-    s_idx = jnp.arange(8, dtype=jnp.uint8)
-    bits = (prod[:, :, :, None, :] >> s_idx[None, None, None, :, None]) & 1
-    return bits.transpose(0, 1, 3, 2, 4).reshape(n, 8 * m, 8 * c).astype(jnp.int8)
+    u = jnp.arange(8, dtype=jnp.uint8)
+    a_bits = ((D[:, :, :, None] >> u) & 1).astype(jnp.int8)  # [n, m, c, u]
+    B = jnp.asarray(_bit_basis())  # [u, s, t]
+    acc = jnp.einsum(
+        "nmcu,ust->nmsct", a_bits, B, preferred_element_type=jnp.int32
+    )
+    out = (acc & 1).astype(jnp.int8)
+    return out.reshape(n, 8 * m, 8 * c)
 
 
 def _decode_axes_dev(
@@ -247,7 +254,14 @@ def _repair_verify(
     return repaired, mismatch, provided_mismatch, roots
 
 
-@lru_cache(maxsize=None)
+# Honest DAS masks peel in 1-2 phases; each extra phase unrolls another
+# full decode pipeline into the XLA program.  Bounding the device path (and
+# the executable cache) stops an adversarial staircase mask from forcing
+# unbounded multi-second recompiles — deeper peels take the host path.
+_MAX_DEVICE_PHASES = 4
+
+
+@lru_cache(maxsize=8)
 def _repair_verify_fn(k: int, phases: int, chunk: int, with_roots: bool):
     return jax.jit(
         partial(_repair_verify, k=k, chunk=chunk, with_roots=with_roots)
@@ -338,7 +352,12 @@ def repair_square_device(
     else:
         rk, rm, ck, cm = schedule
         P = rk.shape[0]
-    chunk = min(n2, max(1, 2048 // k))  # ~bounded D_bits working set
+    if P > _MAX_DEVICE_PHASES:
+        # degenerate (adversarial) masks: don't let each one compile its
+        # own P-phase device program — the host path handles any depth
+        out = repair_square(eds, available, row_roots, col_roots)
+        return jnp.asarray(out) if return_device else out
+    chunk = min(n2, max(1, 8192 // k))  # ~bounded D_bits working set
     with_roots = row_roots is not None or col_roots is not None
     t1 = _t.time()
     masked_dev = jax.device_put(jnp.asarray(masked))

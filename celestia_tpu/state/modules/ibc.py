@@ -127,7 +127,9 @@ class ChannelKeeper:
             )
         return ch
 
-    def send_packet(self, channel_id: str, data: bytes) -> Tuple[Packet, int]:
+    def send_packet(
+        self, channel_id: str, data: bytes, timeout_height: int = 0
+    ) -> Tuple[Packet, int]:
         ch = self.channels.get(channel_id)
         if ch is None or ch.state != "OPEN":
             raise ValueError(f"channel {channel_id} is not open")
@@ -139,15 +141,15 @@ class ChannelKeeper:
             dest_port=ch.counterparty_port,
             dest_channel=ch.counterparty_channel,
             data=data,
+            timeout_height=timeout_height,
         )
-        commitment = hashlib.sha256(data).digest()
+        keys = self._skeys()
+        commitment = keys.packet_commitment(data, timeout_height)
         self.commitments[(channel_id, seq)] = commitment
         if self.store is not None:
+            self.store.set(keys.commitment_key(channel_id, seq), commitment)
             self.store.set(
-                self._skeys().commitment_key(channel_id, seq), commitment
-            )
-            self.store.set(
-                f"nextseq/{channel_id}".encode(),
+                keys.nextseq_key(channel_id),
                 self._next_seq[channel_id].to_bytes(8, "big"),
             )
         self.sent.append((packet, seq))
@@ -177,12 +179,15 @@ class ChannelKeeper:
     def has_receipt(self, channel_id: str, seq: int) -> bool:
         return (channel_id, seq) in self._received
 
-    def claim_commitment(self, channel_id: str, seq: int, data: bytes) -> None:
+    def claim_commitment(
+        self, channel_id: str, seq: int, data: bytes, timeout_height: int = 0
+    ) -> None:
         """Check-and-delete: the stored commitment must exist and match the
-        packet data (ibc-go's AcknowledgePacket/TimeoutPacket verify the
-        same before the app callback).  A missing commitment means the
-        packet's lifecycle already completed — acting on it again would
-        refund twice, so this RAISES instead of silently ignoring."""
+        packet data + timeout (ibc-go's AcknowledgePacket/TimeoutPacket
+        verify the same before the app callback).  A missing commitment
+        means the packet's lifecycle already completed — acting on it
+        again would refund twice, so this RAISES instead of silently
+        ignoring."""
         key = (channel_id, seq)
         stored = self.commitments.get(key)
         if stored is None:
@@ -190,7 +195,7 @@ class ChannelKeeper:
                 f"no commitment for packet {channel_id}#{seq}: already "
                 f"acked or timed out"
             )
-        if stored != hashlib.sha256(data).digest():
+        if stored != self._skeys().packet_commitment(data, timeout_height):
             raise ValueError(f"commitment mismatch for packet {channel_id}#{seq}")
         del self.commitments[key]
         if self.store is not None:
@@ -201,7 +206,9 @@ class ChannelKeeper:
     def mark_timed_out(self, channel_id: str, seq: int) -> None:
         self._timed_out.add((channel_id, seq))
         if self.store is not None:
-            self.store.set(f"timedout/{channel_id}/{seq}".encode(), b"\x01")
+            self.store.set(
+                self._skeys().timedout_key(channel_id, seq), b"\x01"
+            )
 
     def is_timed_out(self, channel_id: str, seq: int) -> bool:
         return (channel_id, seq) in self._timed_out
@@ -225,6 +232,7 @@ class TransferModule:
         denom: str,
         channel_id: str,
         memo: str = "",
+        timeout_height: int = 0,
     ) -> Tuple[Packet, int]:
         """memo rides inside the committed packet data (it carries
         packet-forward instructions, so it MUST be covered by the
@@ -248,7 +256,7 @@ class TransferModule:
             receiver=receiver,
             memo=memo,
         ).to_json()
-        return self.channels.send_packet(channel_id, data)
+        return self.channels.send_packet(channel_id, data, timeout_height)
 
     # -- receive side --------------------------------------------------
 
@@ -285,7 +293,9 @@ class TransferModule:
     ) -> None:
         # check-and-claim guards replay: a second ack (or ack-after-
         # timeout) raises instead of refunding twice
-        self.channels.claim_commitment(packet.source_channel, seq, packet.data)
+        self.channels.claim_commitment(
+            packet.source_channel, seq, packet.data, packet.timeout_height
+        )
         if ack.success:
             return
         self._refund(packet)
@@ -295,7 +305,9 @@ class TransferModule:
         OnTimeoutPacket).  The commitment claim rejects timeout-after-ack,
         double-timeout, and fabricated packets — the refund only ever
         fires once per real in-flight send."""
-        self.channels.claim_commitment(packet.source_channel, seq, packet.data)
+        self.channels.claim_commitment(
+            packet.source_channel, seq, packet.data, packet.timeout_height
+        )
         self._refund(packet)
 
     def _refund(self, packet: Packet) -> None:
@@ -489,11 +501,15 @@ class ICAControllerModule:
     def on_acknowledgement(
         self, packet: Packet, seq: int, ack: Acknowledgement
     ) -> None:
-        self.channels.claim_commitment(packet.source_channel, seq, packet.data)
+        self.channels.claim_commitment(
+            packet.source_channel, seq, packet.data, packet.timeout_height
+        )
         self.results[(packet.source_channel, seq)] = ack
 
     def on_timeout_packet(self, packet: Packet, seq: int) -> None:
-        self.channels.claim_commitment(packet.source_channel, seq, packet.data)
+        self.channels.claim_commitment(
+            packet.source_channel, seq, packet.data, packet.timeout_height
+        )
         self.results[(packet.source_channel, seq)] = Acknowledgement(
             False, "packet timed out"
         )
@@ -685,10 +701,21 @@ def recv_packet_verified(
         )
     if stack.channels.has_receipt(packet.dest_channel, seq):
         raise ClientError(f"packet {packet.dest_channel}#{seq} already received")
+    # ICS-4 timeout: once THIS chain's height passes the packet's
+    # timeout, receiving is deterministically refused — which is what
+    # makes the source side's absence-proof refund safe (the packet can
+    # never be delivered after the proven height)
+    if packet.timeout_height and stack.app is not None:
+        if stack.app.store.last_height >= packet.timeout_height:
+            raise ClientError(
+                f"packet timed out at height {packet.timeout_height}"
+            )
+    from celestia_tpu.state.modules.ibc_client import packet_commitment
+
     client.verify_membership(
         proof_height,
         commitment_key(packet.source_channel, seq),
-        hashlib.sha256(packet.data).digest(),
+        packet_commitment(packet.data, packet.timeout_height),
         proof,
     )
     stack.channels.write_receipt(packet.dest_channel, seq)
@@ -743,6 +770,58 @@ def ack_packet_verified(
     stack.app_module_for(packet).on_acknowledgement(packet, seq, ack)
 
 
+def timeout_packet_verified(
+    stack: IBCStack,
+    packet: Packet,
+    seq: int,
+    absence_proof: dict,
+    proof_height: int,
+) -> None:
+    """Proof-gated timeout (ibc-go core TimeoutPacket): refund only with
+    an ABSENCE proof that the destination never wrote a receive receipt
+    for this packet, at a proven height at or past the packet's timeout.
+    Because the destination deterministically refuses receives once its
+    height passes timeout_height (recv_packet_verified), a packet proven
+    unreceived at such a height can never be delivered later — the refund
+    cannot double-spend."""
+    from celestia_tpu.state.modules.ibc_client import (
+        ClientError,
+        receipt_key,
+    )
+
+    if not packet.timeout_height:
+        raise ClientError("packet has no timeout; it cannot be timed out")
+    client = stack.connections.client_for_channel(packet.source_channel)
+    if client is None:
+        raise ClientError(
+            f"channel {packet.source_channel} is not bound to a client"
+        )
+    ch = stack.channels.channels.get(packet.source_channel)
+    if ch is None:
+        raise ClientError(f"unknown channel {packet.source_channel}")
+    if (
+        ch.counterparty_channel != packet.dest_channel
+        or ch.counterparty_port != packet.dest_port
+        or ch.port != packet.source_port
+    ):
+        raise ClientError(
+            "timeout routing does not match the channel's counterparty"
+        )
+    # the proven height must itself be past the timeout: consensus state
+    # at H proves the destination's state as of H-1
+    if proof_height - 1 < packet.timeout_height:
+        raise ClientError(
+            f"proof height {proof_height} does not show the timeout "
+            f"({packet.timeout_height}) elapsed"
+        )
+    client.verify_non_membership(
+        proof_height,
+        receipt_key(packet.dest_channel, seq),
+        absence_proof,
+    )
+    stack.app_module_for(packet).on_timeout_packet(packet, seq)
+
+
 class SecureRelayer:
     """An UNTRUSTED relayer between two App-backed chains: it moves
     (header, certificate) pairs to update clients and (packet, proof)
@@ -792,3 +871,20 @@ class SecureRelayer:
         )
         ack_packet_verified(src_chain.stack, packet, seq, ack, ack_proof, d + 1)
         return ack
+
+    def timeout(self, src_chain, packet: Packet, seq: int) -> None:
+        """Trustless timeout: wait for the destination to provably pass
+        the packet's timeout height, then refund against an ABSENCE proof
+        of the receive receipt."""
+        from celestia_tpu.state.modules.ibc_client import receipt_key
+
+        dst_chain = self._other(src_chain)
+        while dst_chain.app.store.last_height < packet.timeout_height:
+            dst_chain.commit_block()
+        dst_chain.commit_block()  # header proving the post-timeout state
+        d = dst_chain.app.store.last_height - 1
+        self.update_client(src_chain, dst_chain, d + 1)
+        proof = dst_chain.app.store.prove(
+            "ibc", receipt_key(packet.dest_channel, seq), d
+        )
+        timeout_packet_verified(src_chain.stack, packet, seq, proof, d + 1)

@@ -128,6 +128,17 @@ class LightClient:
             raise ClientError(
                 f"certificate power {power} below 2/3 of {self.total_power}"
             )
+        # misbehaviour: two CERTIFIED headers at one height with different
+        # roots means the counterparty valset double-signed — freeze the
+        # client permanently (07-tendermint freezes the same way); a
+        # relayer must never be able to pick which fork proofs verify on
+        existing = self.consensus_states.get(height)
+        if existing is not None and existing.root != prev_app_hash:
+            self.frozen = True
+            raise ClientError(
+                f"misbehaviour: conflicting certified headers at height "
+                f"{height}; client {self.client_id} frozen"
+            )
         # Tendermint semantics: the header at H proves app_hash(H-1);
         # record it as the consensus state AT H
         self.consensus_states[height] = ConsensusState(
@@ -232,6 +243,23 @@ class ConnectionKeeper:
 
 def commitment_key(channel_id: str, seq: int) -> bytes:
     return f"commitments/{channel_id}/{seq}".encode()
+
+
+def nextseq_key(channel_id: str) -> bytes:
+    return f"nextseq/{channel_id}".encode()
+
+
+def timedout_key(channel_id: str, seq: int) -> bytes:
+    return f"timedout/{channel_id}/{seq}".encode()
+
+
+def packet_commitment(data: bytes, timeout_height: int) -> bytes:
+    """What `commitments/{channel}/{seq}` stores: covers the data AND the
+    timeout, so a relayer can neither tamper the payload nor stretch the
+    packet's deliverability window."""
+    return hashlib.sha256(
+        timeout_height.to_bytes(8, "big") + data
+    ).digest()
 
 
 def ack_key(channel_id: str, seq: int) -> bytes:

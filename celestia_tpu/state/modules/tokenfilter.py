@@ -58,6 +58,10 @@ class Packet:
     dest_port: str
     dest_channel: str
     data: bytes
+    # ICS-4 timeout: the packet is undeliverable once the DESTINATION
+    # chain's height exceeds this (0 = no timeout).  Covered by the
+    # packet commitment, so a relayer cannot alter it.
+    timeout_height: int = 0
 
 
 @dataclass(frozen=True)

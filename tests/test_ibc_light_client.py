@@ -286,6 +286,113 @@ def test_guards_survive_restore():
         rck.write_receipt("channel-0", 9)
 
 
+def test_verified_timeout_refunds_on_absence_proof(chains):
+    """Trustless timeout: the destination provably passed the packet's
+    timeout without receiving it -> absence proof -> refund.  And a
+    packet that WAS received cannot be 'timed out' (the receipt's
+    membership breaks the absence proof)."""
+    a, b = chains
+    from celestia_tpu.state.modules.ibc import timeout_packet_verified
+    from celestia_tpu.state.modules.ibc_client import receipt_key
+
+    alice = b"\xa8" * 20
+    _fund(a, alice)
+    relayer = SecureRelayer(a, b)
+    timeout_h = b.app.store.last_height + 3
+    packet, seq = a.stack.module.send_transfer(
+        alice, "ab" * 10, 123, NATIVE_DENOM, "channel-0",
+        timeout_height=timeout_h,
+    )
+    before = a.app.bank.balance(alice)
+    relayer.timeout(a, packet, seq)
+    assert a.app.bank.balance(alice) == before + 123  # escrow refunded
+    # double-timeout: the commitment is claimed, second refund refused
+    d = b.app.store.last_height - 1
+    proof = b.app.store.prove("ibc", receipt_key("channel-0", seq), d)
+    with pytest.raises(ValueError, match="already acked or timed out"):
+        timeout_packet_verified(a.stack, packet, seq, proof, d + 1)
+    # late delivery on B is deterministically refused past the timeout
+    h = a.app.store.last_height
+    a.commit_block()
+    a.commit_block()
+    h = a.app.store.last_height - 1
+    relayer.update_client(b, a, h + 1)
+    cproof = a.app.store.prove("ibc", commitment_key("channel-0", seq), h)
+    with pytest.raises(ClientError, match="timed out"):
+        recv_packet_verified(b.stack, packet, seq, cproof, h + 1)
+
+
+def test_timeout_needs_absence_proof(chains):
+    """A relayer cannot time out a DELIVERED packet: the receipt exists,
+    so the absence proof fails."""
+    a, b = chains
+    from celestia_tpu.state.modules.ibc import timeout_packet_verified
+    from celestia_tpu.state.modules.ibc_client import receipt_key
+
+    alice = b"\xa9" * 20
+    _fund(a, alice)
+    relayer = SecureRelayer(a, b)
+    timeout_h = b.app.store.last_height + 50
+    packet, seq = a.stack.module.send_transfer(
+        alice, "cd" * 10, 321, NATIVE_DENOM, "channel-0",
+        timeout_height=timeout_h,
+    )
+    relayer.relay(a, packet, seq)  # delivered (error ack refunds already)
+    bal_after_ack = a.app.bank.balance(alice)
+    while b.app.store.last_height < timeout_h:
+        b.commit_block()
+    b.commit_block()
+    d = b.app.store.last_height - 1
+    relayer.update_client(a, b, d + 1)
+    proof = b.app.store.prove("ibc", receipt_key("channel-0", seq), d)
+    with pytest.raises(ClientError, match="absence|expected an absence"):
+        timeout_packet_verified(a.stack, packet, seq, proof, d + 1)
+    assert a.app.bank.balance(alice) == bal_after_ack  # no double refund
+
+
+def test_misbehaving_valset_freezes_client():
+    """Two conflicting certified headers at one height freeze the client
+    permanently (07-tendermint misbehaviour semantics)."""
+    from celestia_tpu.node.bft import Vote as BftVote, PRECOMMIT, vote_sign_bytes
+
+    a = Chain("lc-freeze-a")
+    vals, pubs = a.valset()
+    client = LightClient("07-a", a.chain_id, vals, pubs)
+    a.commit_block()
+    h = a.net.height
+    header, cert = a.header_and_cert(h)
+    client.update(header, cert)
+    # the (single-validator) counterparty double-signs a conflicting
+    # header at the same height with a different prev_app_hash
+    evil = dict(header)
+    evil["prev_app_hash"] = "55" * 32
+    from celestia_tpu.node.bft import block_id_of
+
+    evil_id = block_id_of(
+        h, int(evil["time_ns"]), int(evil["square_size"]),
+        bytes.fromhex(evil["data_root"]), bytes.fromhex(evil["proposer"]),
+        bytes.fromhex(evil["last_commit_digest"]),
+        bytes.fromhex(evil["prev_app_hash"]),
+    )
+    key = a.net.validators[0].key
+    r = cert[0]["round"]
+    evil_cert = [
+        BftVote(
+            vtype=PRECOMMIT, height=h, round=int(r), block_id=evil_id,
+            validator=key.public_key().address(),
+            signature=key.sign(
+                vote_sign_bytes(a.chain_id, h, int(r), PRECOMMIT, evil_id)
+            ),
+        ).to_wire()
+    ]
+    with pytest.raises(ClientError, match="misbehaviour"):
+        client.update(evil, evil_cert)
+    assert client.frozen
+    # frozen: even the honest header is now refused
+    with pytest.raises(ClientError, match="frozen"):
+        client.update(header, cert)
+
+
 def test_forged_header_rejected():
     """A relayer cannot advance a client with a header signed by the
     wrong keys, an undersized certificate, or a tampered app hash."""

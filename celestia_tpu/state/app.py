@@ -139,6 +139,13 @@ class App:
         # on every commit (baseapp checkState parity) — lets several pending
         # txs from one account chain their sequences in the mempool
         self._check_state: Optional[MultiStore] = None
+        # verified-signature cache (tx-bytes hash -> True), bounded LRU:
+        # Prepare->Process on one node and repeat validations of pooled
+        # txs skip redundant EC multiplications (comet's tx cache role)
+        from collections import OrderedDict
+
+        self._sig_cache: "OrderedDict[bytes, bool]" = OrderedDict()
+        self._sig_cache_max = 8192
 
     def _wire_keepers(self) -> None:
         self.accounts = AccountKeeper(self.store.store("auth"))
@@ -314,6 +321,15 @@ class App:
         leans on C secp256k1 for the same reason, SURVEY.md §2.2).
 
         Yields (raw, tx, raw_inner, sig_ok, decode_error) per input tx.
+
+        Verified signatures are cached by tx-bytes hash (bounded LRU):
+        a proposer's own ProcessProposal re-check of the block it just
+        built, and repeat validations of the same bytes across proposal
+        rounds, skip the EC multiplications — the dominant per-block
+        host cost.  Only a verifying (pubkey, sign_bytes, signature)
+        triple derived from the EXACT raw bytes is ever cached, so a hit
+        proves the same signature check.  (CheckTx verifies inline in
+        the ante chain and does not populate this cache.)
         """
         from celestia_tpu.utils.secp256k1 import verify_batch
 
@@ -336,24 +352,58 @@ class App:
             except (AnteError, ValueError) as e:
                 decoded.append((raw, None, None, e))
         # single-key txs batch-verify natively; multisig txs fall back to
-        # inline verification inside the ante chain (sig_ok=None)
-        live = [d for d in decoded if d[1] is not None and not d[1].is_multisig()]
+        # inline verification inside the ante chain (sig_ok=None).
+        # batch_ok is THIS call's key -> verdict map: cache hits resolve
+        # to True, each distinct fresh key is verified once (duplicates
+        # dedupe), and the output loop reads ONLY batch_ok — immune to
+        # LRU evictions _remember_sig performs mid-call.
+        import hashlib as _hashlib
+
+        batch_ok: Dict[bytes, Optional[bool]] = {}
+        keys: List[Optional[bytes]] = []
+        live: List[tuple] = []
+        live_keys: List[bytes] = []
+        for d in decoded:
+            if d[1] is None or d[1].is_multisig():
+                keys.append(None)
+                continue
+            key = _hashlib.sha256(d[0]).digest()
+            keys.append(key)
+            if key in batch_ok:
+                continue
+            if key in self._sig_cache:
+                self._sig_cache.move_to_end(key)
+                batch_ok[key] = True
+            else:
+                batch_ok[key] = None  # to be verified below
+                live.append(d)
+                live_keys.append(key)
         sig_results = verify_batch(
             [tx.sign_bytes(self.chain_id) for _, tx, _, _ in live],
             [tx.signature for _, tx, _, _ in live],
             [tx.pubkey for _, tx, _, _ in live],
         )
-        ok_iter = iter(sig_results)
+        for key, ok in zip(live_keys, sig_results):
+            batch_ok[key] = bool(ok)
+            if ok:
+                self._remember_sig(key)
         out = []
-        for raw, tx, raw_inner, err in decoded:
+        for d, key in zip(decoded, keys):
+            raw, tx, raw_inner, err = d
             if tx is None:
                 sig_ok = False
             elif tx.is_multisig():
                 sig_ok = None
             else:
-                sig_ok = next(ok_iter)
+                sig_ok = batch_ok[key]
             out.append((raw, tx, raw_inner, sig_ok, err))
         return out
+
+    def _remember_sig(self, key: bytes) -> None:
+        self._sig_cache[key] = True
+        self._sig_cache.move_to_end(key)
+        while len(self._sig_cache) > self._sig_cache_max:
+            self._sig_cache.popitem(last=False)
 
     def _filter_txs(self, txs: List[bytes]) -> List[bytes]:
         """FilterTxs parity (validate_txs.go:29-97): run the ante chain over

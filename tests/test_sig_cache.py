@@ -1,0 +1,104 @@
+"""Verified-signature cache: redundant EC verification elimination.
+
+The proposer's own ProcessProposal re-checks the block it just built,
+and repeated proposal rounds re-validate identical bytes.
+Only (raw-bytes-hash -> verified) is cached, so a hit proves the exact
+same signature check; tampered bytes miss the cache and fail outright.
+"""
+
+from celestia_tpu.state.app import App
+from celestia_tpu.state.tx import Fee, MsgSend, Tx
+from celestia_tpu.utils.secp256k1 import PrivateKey
+
+
+def _mk_app_and_txs(n=24):
+    key = PrivateKey.from_seed(b"sigcache")
+    app = App(chain_id="sigcache-1")
+    app.init_chain(
+        {
+            "chain_id": "sigcache-1",
+            "genesis_time_ns": 1,
+            "accounts": [
+                {"address": key.public_key().address().hex(), "balance": 10**12}
+            ],
+        }
+    )
+    addr = key.public_key().address()
+    txs = []
+    for i in range(n):
+        tx = Tx(
+            (MsgSend(addr, b"\x61" * 20, 1 + i),),
+            Fee(200_000, 100_000),
+            key.public_key().compressed(),
+            sequence=i,
+            account_number=app.accounts.peek(addr).account_number,
+        )
+        txs.append(tx.signed(key, app.chain_id).marshal())
+    return app, txs
+
+
+def test_cache_hit_skips_reverification_and_matches():
+    app, txs = _mk_app_and_txs()
+    first = app._decode_proposal_txs(txs)
+    assert all(ok for _, _, _, ok, _ in first)
+    assert len(app._sig_cache) == len(txs)
+    second = app._decode_proposal_txs(txs)
+    assert [ok for *_, ok, _ in second] == [ok for *_, ok, _ in first]
+
+
+def test_tampered_tx_misses_cache_and_fails():
+    app, txs = _mk_app_and_txs(4)
+    app._decode_proposal_txs(txs)
+    # flip a byte in the signature region (tail) of a cached tx
+    bad = txs[0][:-1] + bytes([txs[0][-1] ^ 1])
+    out = app._decode_proposal_txs([bad])
+    (_, _, _, sig_ok, err) = out[0]
+    assert err is not None or sig_ok is False
+
+
+def test_invalid_signatures_are_never_cached():
+    app, txs = _mk_app_and_txs(3)
+    forged = txs[0][:-64] + b"\x01" * 64
+    out = app._decode_proposal_txs([forged])
+    (_, _, _, sig_ok, err) = out[0]
+    assert err is not None or sig_ok is False
+    import hashlib
+
+    assert hashlib.sha256(forged).digest() not in app._sig_cache
+
+
+def test_cache_is_bounded():
+    app, txs = _mk_app_and_txs(6)
+    app._sig_cache_max = 4
+    app._decode_proposal_txs(txs)
+    assert len(app._sig_cache) <= 4
+
+
+def test_prepare_then_process_round_trip_uses_cache():
+    app, txs = _mk_app_and_txs(12)
+    prop = app.prepare_proposal(txs)
+    before = len(app._sig_cache)
+    assert before >= 12
+    ok, reason = app.process_proposal(
+        prop.block_txs, prop.square_size, prop.data_root
+    )
+    assert ok, reason
+
+
+def test_cache_hit_survives_mid_batch_eviction():
+    """Regression (review finding): a cache-hit tx whose entry gets
+    LRU-evicted by fresh verifications in the SAME batch must still
+    resolve (the output loop reads the per-batch map, not the mutated
+    cache)."""
+    app, txs = _mk_app_and_txs(8)
+    app._decode_proposal_txs(txs[:1])  # tx0 cached
+    app._sig_cache_max = 2  # next batch's fresh inserts will evict tx0
+    out = app._decode_proposal_txs(txs)  # tx0 hits cache, 7 fresh verify
+    assert all(ok for _, _, _, ok, _ in out)
+
+
+def test_duplicate_txs_verified_once():
+    app, txs = _mk_app_and_txs(2)
+    out = app._decode_proposal_txs([txs[0]] * 5 + [txs[1]])
+    assert all(ok for _, _, _, ok, _ in out)
+    assert len(app._sig_cache) == 2

@@ -194,7 +194,16 @@ def cmd_start(args) -> int:
             )
         elif latest_snap is not None:
             # the block log was fully torn; the snapshot is newer than a
-            # genesis reset, so prefer it
+            # genesis reset, so prefer it.  The throwaway node has already
+            # wiped + reopened the logs and seeded genesis STATE records —
+            # release its file handles and clear those records so the
+            # snapshot node reopens a clean data dir (no stale
+            # pre-checkpoint genesis state, no leaked fds)
+            node.close()
+            for name in ("state.log", "blocks.log"):
+                p = Path(data_dir) / name
+                if p.exists():
+                    p.unlink()
             node = None
         else:
             log.info("block log unreadable; restarted from genesis")

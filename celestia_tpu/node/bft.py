@@ -46,6 +46,11 @@ STEP_PRECOMMIT = "precommit"
 
 
 def _varint(n: int) -> bytes:
+    if n < 0:
+        # a negative int never terminates the shift loop below; every
+        # wire decoder range-checks before reaching here, this is the
+        # last line of defense against a hang
+        raise ValueError(f"varint of negative int {n}")
     out = bytearray()
     while True:
         b = n & 0x7F
@@ -173,10 +178,16 @@ class BlockPayload:
 
     @classmethod
     def from_wire(cls, d: dict) -> "BlockPayload":
+        height = int(d["height"])
+        time_ns = int(d["time_ns"])
+        square_size = int(d["square_size"])
+        if height <= 0 or time_ns < 0 or square_size < 0:
+            # negative ints would spin _varint forever in block_id_of
+            raise ValueError("payload fields out of range")
         return cls(
-            height=int(d["height"]),
-            time_ns=int(d["time_ns"]),
-            square_size=int(d["square_size"]),
+            height=height,
+            time_ns=time_ns,
+            square_size=square_size,
             data_root=bytes.fromhex(d["data_root"]),
             txs=tuple(bytes.fromhex(t) for t in d["txs"]),
             proposer=bytes.fromhex(d.get("proposer", "")),
@@ -209,10 +220,15 @@ class Proposal:
 
     @classmethod
     def from_wire(cls, d: dict) -> "Proposal":
+        height = int(d["height"])
+        round_ = int(d["round"])
+        pol_round = int(d["pol_round"])
+        if height <= 0 or round_ < 0 or pol_round < -1:
+            raise ValueError("proposal fields out of range")
         return cls(
-            height=int(d["height"]),
-            round=int(d["round"]),
-            pol_round=int(d["pol_round"]),
+            height=height,
+            round=round_,
+            pol_round=pol_round,
             payload=BlockPayload.from_wire(d["payload"]),
             proposer=bytes.fromhex(d["proposer"]),
             signature=bytes.fromhex(d["signature"]),
@@ -241,10 +257,15 @@ class Vote:
 
     @classmethod
     def from_wire(cls, d: dict) -> "Vote":
+        height = int(d["height"])
+        round_ = int(d["round"])
+        if height <= 0 or round_ < 0:
+            # negative ints would spin _varint forever in vote_sign_bytes
+            raise ValueError("vote fields out of range")
         return cls(
             vtype=d["vtype"],
-            height=int(d["height"]),
-            round=int(d["round"]),
+            height=height,
+            round=round_,
             block_id=bytes.fromhex(d["block_id"]),
             validator=bytes.fromhex(d["validator"]),
             signature=bytes.fromhex(d["signature"]),
@@ -264,12 +285,24 @@ class DecidedBlock:
     precommits: List[Vote] = field(default_factory=list)
 
 
+# Ceiling on how far ahead of the validator's own clock a proposed block
+# timestamp may sit.  Tendermint derives BFT-time from commit votes; here
+# the proposer names the time and every replica enforces the same two
+# rules (strict monotonicity + bounded drift), which keeps _now_ns, block
+# headers and time-based mint inflation out of a Byzantine proposer's
+# control (reference: celestia-core header validation / BFT-time).
+DEFAULT_MAX_TIME_DRIFT_NS = 60_000_000_000  # 60 s
+
+
 def validate_payload_against_chain(
     engine: "BFTNode",
     payload: BlockPayload,
     prev_block_id: Optional[bytes],
     first_bft_height: int = 2,
     expected_prev_app_hash: Optional[bytes] = None,
+    prev_time_ns: Optional[int] = None,
+    now_ns: Optional[int] = None,
+    max_drift_ns: int = DEFAULT_MAX_TIME_DRIFT_NS,
 ) -> Tuple[bool, str]:
     """Shared certificate-validation glue for every transport tier.
 
@@ -282,7 +315,16 @@ def validate_payload_against_chain(
       the payload's prev_app_hash must equal it — this is what turns a
       commit certificate into a light-client-verifiable state-root proof
       (Tendermint header.AppHash semantics).
+    - When the caller supplies prev_time_ns (its last committed block
+      time), the payload's time must be strictly after it; when it
+      supplies now_ns (its own clock), the payload's time must be within
+      max_drift_ns of it.  Every tier inherits the timestamp rules by
+      validating through this one path.
     """
+    if prev_time_ns is not None and payload.time_ns <= prev_time_ns:
+        return False, "proposal time is not after the previous block"
+    if now_ns is not None and payload.time_ns > now_ns + max_drift_ns:
+        return False, "proposal time is beyond the allowed clock drift"
     if expected_prev_app_hash is not None and payload.prev_app_hash != (
         expected_prev_app_hash
     ):
@@ -449,6 +491,8 @@ class BFTNode:
         seen: Set[bytes] = set()
         power = 0
         for v in precommits:
+            if v.round < 0:
+                return False, "negative round in certificate"
             if v.vtype != PRECOMMIT or v.height != h or v.block_id != bid:
                 return False, "certificate vote does not match the block"
             if v.validator in seen:
@@ -663,8 +707,16 @@ class BFTNode:
     ) -> Tuple[bool, str]:
         """Check a payload's last_commit: every vote must be a valid
         precommit signature by a known validator over prev_block_id, one
-        per validator, totalling >= 2/3 power.  Used by harness
+        per validator, totalling >= 2/3 power, all from ONE round — a
+        commit is the set of precommits that co-existed in the round that
+        decided, so mixing genuine votes from different rounds would
+        fabricate a certificate that never existed (same rule as
+        adopt_decision and LightClient.update).  Used by harness
         validate_fns so a proposer cannot forge reward/slash inputs."""
+        if len({v.round for v in payload.last_commit}) > 1:
+            return False, "commit certificate mixes rounds"
+        if any(v.round < 0 for v in payload.last_commit):
+            return False, "negative round in commit certificate"
         seen: Set[bytes] = set()
         power = 0
         for v in payload.last_commit:

@@ -152,6 +152,15 @@ class BFTNetwork:
             ok, why = validate_payload_against_chain(
                 val.engine, payload, self._block_ids.get(payload.height - 1),
                 expected_prev_app_hash=expected,
+                prev_time_ns=self._now_ns,
+                # the harness is clock-free: simulated chain time is the
+                # validator's clock.  The bound is a small multiple of
+                # the block interval so a Byzantine proposer cannot creep
+                # chain time forward by a large drift allowance on every
+                # block it proposes (honest proposals sit at exactly
+                # prev + interval)
+                now_ns=self._now_ns,
+                max_drift_ns=2 * self.block_interval_ns,
             )
             if not ok:
                 return False, f"bad commit certificate: {why}"

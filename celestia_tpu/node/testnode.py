@@ -263,9 +263,26 @@ class TestNode:
             expected = self.app.store.committed_hash(payload.height - 1)
         except KeyError:
             expected = None
+        # Timestamp anchor: the PREVIOUS BLOCK's header time when this
+        # node has it.  Not _now_ns — a snapshot-restored node's _now_ns
+        # is wall/genesis time, which can sit far ahead of chain time and
+        # would make it nil-prevote every honest proposal forever.  When
+        # the previous block is unknown (fresh post-restore) both checks
+        # are skipped; they re-arm at the next committed block.  The
+        # drift bound is a small multiple of the interval so a Byzantine
+        # proposer cannot creep chain time by a large allowance per
+        # block (honest proposals sit at exactly prev + interval).
+        prev_time = None
+        if self.blocks and (
+            self.blocks[-1].header.height == payload.height - 1
+        ):
+            prev_time = self.blocks[-1].header.time_ns
         ok, why = validate_payload_against_chain(
             self._bft, payload, self._bft_block_ids.get(payload.height - 1),
             expected_prev_app_hash=expected,
+            prev_time_ns=prev_time,
+            now_ns=prev_time,
+            max_drift_ns=2 * self.block_interval_ns,
         )
         if not ok:
             return False, f"bad commit certificate: {why}"

@@ -147,7 +147,14 @@ class App:
         self._sig_cache: "OrderedDict[bytes, bool]" = OrderedDict()
         self._sig_cache_max = 8192
 
-    def _wire_keepers(self) -> None:
+    def _wire_keepers(self, rebuild_ibc: bool = True) -> None:
+        """Re-point every keeper at the current self.store.
+
+        rebuild_ibc=False (the per-tx branch swap in deliver) reuses the
+        existing IBC stack and only swaps its store/bank handles — a full
+        rebuild rescans + JSON-decodes the whole "ibc" substore, which
+        would be paid twice per delivered tx for state no msg can touch.
+        Restores/imports keep the default full rebuild (rehydrate)."""
         self.accounts = AccountKeeper(self.store.store("auth"))
         self.bank = BankKeeper(self.store.store("bank"))
         self.params = ParamsKeeper(self.store.store("params"))
@@ -183,10 +190,14 @@ class App:
         # channel handshakes are operator-driven (ibc.open_channel)
         from celestia_tpu.state.modules.ibc import IBCStack
 
-        self.ibc = IBCStack(
-            name=self.chain_id, bank=self.bank, filtered=True, app=self,
-            store=self.store.store("ibc"),
-        )
+        prior = getattr(self, "ibc", None)
+        if not rebuild_ibc and prior is not None:
+            prior.rebind(self.store.store("ibc"), self.bank)
+        else:
+            self.ibc = IBCStack(
+                name=self.chain_id, bank=self.bank, filtered=True, app=self,
+                store=self.store.store("ibc"),
+            )
 
     # ------------------------------------------------------------------
     # version / sizing
@@ -591,7 +602,7 @@ class App:
         msg_branch = self.store.branch()
         saved_store = self.store
         self.store = msg_branch
-        self._wire_keepers()
+        self._wire_keepers(rebuild_ibc=False)
         events: List[dict] = []
         try:
             for m in tx.msgs:
@@ -605,7 +616,7 @@ class App:
             return TxResult(0, "", tx.fee.gas_limit, meter.consumed, events)
         finally:
             self.store = saved_store
-            self._wire_keepers()
+            self._wire_keepers(rebuild_ibc=False)
 
     def _execute_msg(self, msg: Msg, gas_meter: GasMeter) -> dict:
         if isinstance(msg, MsgSend):

@@ -567,7 +567,7 @@ class ICAHostModule:
         branch = self.app.store.branch()
         saved = self.app.store
         self.app.store = branch
-        self.app._wire_keepers()
+        self.app._wire_keepers(rebuild_ibc=False)
         try:
             meter = GasMeter(10_000_000)
             for msg in msgs:
@@ -579,7 +579,7 @@ class ICAHostModule:
             return Acknowledgement(True)
         finally:
             self.app.store = saved
-            self.app._wire_keepers()
+            self.app._wire_keepers(rebuild_ibc=False)
 
 
 @dataclass
@@ -605,7 +605,12 @@ class IBCStack:
             self.channels.rehydrate()
         from celestia_tpu.state.modules.ibc_client import ConnectionKeeper
 
-        self.connections = ConnectionKeeper()
+        # client state (valsets, consensus states, the frozen flag) and
+        # channel bindings persist in the same "ibc" substore as the
+        # channel keeper's receipts — a restored node's frozen client
+        # stays frozen (disjoint key prefixes; ibc_client.rehydrate)
+        self.connections = ConnectionKeeper(store=self.store)
+        self.connections.rehydrate()
         transfer = TransferModule(self.bank, self.channels, self.name)
         module = TokenFilterMiddleware(transfer) if self.filtered else transfer
         if self.forwarding:
@@ -613,6 +618,30 @@ class IBCStack:
         self.module = module
         self.ica_host = ICAHostModule(self.app) if self.app is not None else None
         self.ica_controller = ICAControllerModule(self.channels)
+
+    def rebind(self, store, bank) -> None:
+        """Swap the underlying KVStore/bank handles without rebuilding or
+        rescanning in-memory state.
+
+        The deliver path branch-swaps the app's store around every tx
+        (state/app.py _wire_keepers); rebuilding the stack there would
+        pay a full "ibc" substore scan + JSON decode per tx for nothing —
+        no msg mutates IBC in-memory state, so only the handles the next
+        WRITE goes through need to move.  Full rebuilds (with rehydrate)
+        remain the restore/import path."""
+        self.store = store
+        self.bank = bank
+        self.channels.store = store
+        self.connections.store = store
+        for client in self.connections.clients.values():
+            client.store = store
+        # the one TransferModule instance is shared by every middleware
+        # layer (token filter wraps it, PFM aliases it as .transfer)
+        mod = self.module
+        while mod is not None and not isinstance(mod, TransferModule):
+            mod = getattr(mod, "app", None)
+        if mod is not None:
+            mod.bank = bank
 
     def on_recv_packet(self, packet: Packet) -> Acknowledgement:
         """Port-level dispatch (IBC router role)."""

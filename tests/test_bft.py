@@ -478,3 +478,131 @@ def test_invalid_proposal_is_rejected_and_chain_continues():
     assert blk.header.data_hash != b"\x66" * 32
     live = net.validators[1]
     assert live.engine.decided[height].round >= 1
+
+
+# ---------------------------------------------------------------------------
+# byzantine timestamps (advisor finding r3): proposal time is validated
+# ---------------------------------------------------------------------------
+
+
+def test_far_future_timestamp_rejected():
+    """A proposer naming a timestamp beyond the drift bound draws nil
+    prevotes everywhere; the round times out and an honest proposer's
+    block (with a sane time) commits instead."""
+    net = BFTNetwork(n_validators=4)
+    net.produce_block()
+    height = net.height + 1
+    eng = net.validators[0].engine
+    proposer_addr = eng.proposer_for(height, 0)
+    byz = next(v for v in net.validators if v.address == proposer_addr)
+    original_fn = byz.engine.propose_fn
+    one_year_ns = 365 * 24 * 3600 * 10**9
+
+    def evil_propose(h, r):
+        payload = original_fn(h, r)
+        if payload is None or r > 0:
+            return payload
+        return BlockPayload(
+            **{**payload.__dict__, "time_ns": payload.time_ns + one_year_ns}
+        )
+
+    byz.engine.propose_fn = evil_propose
+    before = net._now_ns
+    blk = net.produce_block()
+    assert blk.header.height == height
+    live = net.validators[1]
+    assert live.engine.decided[height].round >= 1, "view change expected"
+    # chain time advanced sanely, not by a year
+    assert net._now_ns - before < one_year_ns
+
+
+def test_backwards_timestamp_rejected():
+    """A proposal whose time is <= the previous block's is refused —
+    non-monotonic time would corrupt mint inflation and header order."""
+    net = BFTNetwork(n_validators=4)
+    net.produce_block()
+    height = net.height + 1
+    eng = net.validators[0].engine
+    proposer_addr = eng.proposer_for(height, 0)
+    byz = next(v for v in net.validators if v.address == proposer_addr)
+    original_fn = byz.engine.propose_fn
+
+    def evil_propose(h, r):
+        payload = original_fn(h, r)
+        if payload is None or r > 0:
+            return payload
+        return BlockPayload(
+            **{**payload.__dict__, "time_ns": net._now_ns}  # not after prev
+        )
+
+    byz.engine.propose_fn = evil_propose
+    blk = net.produce_block()
+    assert blk.header.height == height
+    live = net.validators[1]
+    assert live.engine.decided[height].round >= 1
+    committed = live.engine.decided[height].payload
+    assert committed.time_ns > net.blocks[-2].header.time_ns
+
+
+def test_validate_payload_timestamp_rules_direct():
+    from celestia_tpu.node.bft import validate_payload_against_chain
+
+    payload = BlockPayload(
+        height=2, time_ns=1_000, square_size=1,
+        data_root=b"\x00" * 32, txs=(),
+    )
+    # monotonicity: time must be strictly after the previous block's
+    ok, why = validate_payload_against_chain(
+        None, payload, None, prev_time_ns=1_000
+    )
+    assert not ok and "not after" in why
+    # drift: time must be within max_drift_ns of the local clock
+    ok, why = validate_payload_against_chain(
+        None, payload, None, prev_time_ns=0, now_ns=500, max_drift_ns=100
+    )
+    assert not ok and "drift" in why
+    # sane time passes (height 2 = first BFT height, empty last_commit)
+    ok, why = validate_payload_against_chain(
+        None, payload, None, prev_time_ns=500, now_ns=990, max_drift_ns=100
+    )
+    assert ok, why
+
+
+def test_mixed_round_commit_certificate_rejected():
+    """verify_commit_certificate refuses certificates assembling genuine
+    votes from different rounds — a commit is the precommit set of ONE
+    round (matches adopt_decision and LightClient.update)."""
+    net = BFTNetwork(n_validators=4)
+    net.produce_blocks(2)
+    val0 = net.validators[0]
+    decided = val0.engine.decided[3]
+    prev_id = decided.payload.block_id
+    cert = list(decided.precommits)
+    assert len(cert) >= 3
+    # re-sign one validator's precommit at a DIFFERENT round: the vote is
+    # individually genuine (correct key, valid signature) but never
+    # co-existed with the others as one commit
+    victim = cert[0]
+    vkey = next(
+        v.key for v in net.validators if v.address == victim.validator
+    )
+    other_round = victim.round + 1
+    resigned = Vote(
+        vtype=PRECOMMIT, height=victim.height, round=other_round,
+        block_id=victim.block_id, validator=victim.validator,
+        signature=vkey.sign(
+            vote_sign_bytes(
+                net.chain_id, victim.height, other_round, PRECOMMIT,
+                victim.block_id,
+            )
+        ),
+    )
+    payload = BlockPayload(
+        height=4, time_ns=net._now_ns + 1, square_size=1,
+        data_root=b"\x11" * 32, txs=(),
+        proposer=val0.address,
+        last_commit=tuple([resigned] + cert[1:]),
+    )
+    ok, why = val0.engine.verify_commit_certificate(payload, prev_id, 3)
+    assert not ok
+    assert "mixes rounds" in why

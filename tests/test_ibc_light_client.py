@@ -435,3 +435,129 @@ def test_channel_without_client_refuses_verified_receive(chains):
     pkt = Packet("transfer", "channel-9", "transfer", "channel-9", b"{}")
     with pytest.raises(ClientError, match="not bound"):
         recv_packet_verified(b.stack, pkt, 1, {}, 1)
+
+
+def test_client_state_survives_restore():
+    """Regression (advisor finding r3): light clients — valset, consensus
+    states, channel bindings and crucially the misbehaviour `frozen` flag
+    — are mirrored into the merkleized "ibc" substore and rehydrated on
+    restore.  A client frozen for a proven fork must NOT come back
+    unfrozen (proofs would verify against a forked chain again)."""
+    from celestia_tpu.node.bft import (
+        PRECOMMIT,
+        Vote,
+        block_id_of,
+        vote_sign_bytes,
+    )
+    from celestia_tpu.state.app import App
+
+    src = Chain("lc-restore-src")
+    dst = App(chain_id="lc-restore-dst")
+    dst.init_chain({"chain_id": "lc-restore-dst", "genesis_time_ns": 1})
+    vals, pubs = src.valset()
+    client = LightClient("07-src", src.chain_id, vals, pubs)
+    conn = dst.ibc.connections
+    conn.create_client(client)
+    conn.open_connection("connection-0", "07-src")
+    dst.ibc.channels.open_channel("channel-0", "channel-0")
+    conn.bind_channel("channel-0", "connection-0")
+
+    src.commit_block()
+    h = src.net.height
+    header, cert = src.header_and_cert(h)
+    assert client.update(header, cert) == h
+
+    # prove misbehaviour: the validator double-signs a conflicting header
+    # at the same height (1-validator chain, so its lone signature is a
+    # 2/3 certificate) -> the client freezes permanently
+    key = src.net.validators[0].key
+    forged = dict(header)
+    forged["prev_app_hash"] = "66" * 32
+    forged_id = block_id_of(
+        int(forged["height"]),
+        int(forged["time_ns"]),
+        int(forged["square_size"]),
+        bytes.fromhex(forged["data_root"]),
+        bytes.fromhex(forged["proposer"]),
+        bytes.fromhex(forged["last_commit_digest"]),
+        bytes.fromhex(forged["prev_app_hash"]),
+    )
+    vote = Vote(
+        vtype=PRECOMMIT, height=h, round=0, block_id=forged_id,
+        validator=src.net.validators[0].address,
+        signature=key.sign(
+            vote_sign_bytes(src.chain_id, h, 0, PRECOMMIT, forged_id)
+        ),
+    )
+    with pytest.raises(ClientError, match="misbehaviour"):
+        client.update(forged, [vote.to_wire()])
+    assert client.frozen
+
+    dst.store.commit(2)
+    restored = App.restore_from_snapshot(
+        "lc-restore-dst", dst.store.export(), 2, dst.store.committed_hash(2)
+    )
+    rconn = restored.ibc.connections
+    rclient = rconn.clients["07-src"]
+    assert rclient.frozen, "frozen flag must survive the restore"
+    assert rclient.consensus_states[h].root == client.consensus_states[h].root
+    assert rclient.validators == client.validators
+    assert rclient.pubkeys == client.pubkeys
+    assert rconn.client_for_channel("channel-0") is rclient
+    # a frozen restored client still refuses updates
+    with pytest.raises(ClientError, match="frozen"):
+        rclient.update(header, cert)
+
+
+def test_malformed_proof_fails_as_client_error(chains):
+    """Regression (advisor finding r3): garbage relayer proofs must fail
+    verification inside the ClientError contract — not escape as
+    IndexError/ValueError/KeyError."""
+    a, b = chains
+    client = b.client_of_counterparty
+    a.commit_block()
+    a.commit_block()
+    h = a.app.store.last_height - 1
+    self_update = SecureRelayer(a, b)
+    self_update.update_client(b, a, h + 1)
+    key = commitment_key("channel-0", 1)
+    cases = [
+        {},  # missing every field
+        {"store": "ibc", "key": "zz-not-hex", "value": None},
+        {"store": "ibc", "key": key.hex(), "value": "zz-not-hex"},
+        {  # sibling path longer than any possible SMT depth
+            "store": "ibc",
+            "key": key.hex(),
+            "value": "ab",
+            "store_roots": {},
+            "siblings": ["00" * 32] * 300,
+            "leaf": None,
+        },
+        {  # siblings not hex
+            "store": "ibc",
+            "key": key.hex(),
+            "value": "ab",
+            "store_roots": {"ibc": "00" * 32},
+            "siblings": [12345],
+            "leaf": None,
+        },
+    ]
+    for proof in cases:
+        with pytest.raises(ClientError):
+            client.verify_membership(h + 1, key, b"\xab", proof)
+    # malformed header/certificate input to update() also stays in-contract
+    with pytest.raises(ClientError):
+        client.update({"height": "not-an-int"}, [])
+    with pytest.raises(ClientError):
+        client.update(
+            {
+                "height": -1,  # would loop forever in _varint unguarded
+                "time_ns": 0,
+                "square_size": 1,
+                "data_root": "00" * 32,
+                "proposer": "00" * 20,
+                "last_commit_digest": "00" * 32,
+                "prev_app_hash": "00" * 32,
+            },
+            [],
+        )

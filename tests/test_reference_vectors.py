@@ -1,0 +1,143 @@
+"""Golden vectors pinned from the reference Go stack (VERDICT r3 #3).
+
+The reference's test corpus embeds outputs of the real Go
+nmt/rsmt2d/go-square implementations.  Pinning those exact bytes here
+means any byte-level divergence of shares -> square -> RS extension ->
+NMT roots -> data root from the Go stack fails CI — a silent regression
+in share padding or the namespace rule cannot pass.
+
+Sources (all in /root/reference):
+- pkg/da/data_availability_header_test.go:29  MinDataAvailabilityHeader hash
+- pkg/da/data_availability_header_test.go:45  2x2 "typical" DAH hash
+- pkg/da/data_availability_header_test.go:51  128x128 "max square size" DAH hash
+- pkg/da/data_availability_header_test.go:17  nil-DAH hash (RFC-6962 empty)
+- x/blob/types/payforblob_test.go:169-188     the validMsgPayForBlobs blob
+  construction (its commitment has no Go-pinned bytes, so the value here is
+  a self-generated regression anchor over the same construction).
+
+Share fixture construction mirrors generateShares/generateShare
+(data_availability_header_test.go:247-263): every share is the version-0
+namespace 0x00 ‖ 18*0x00 ‖ 10*0x01 followed by 483 bytes of 0xFF; shares
+are identical so the Go corpus's sort is a no-op.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from celestia_tpu.appconsts import (
+    CONTINUATION_SPARSE_SHARE_CONTENT_SIZE,
+    SHARE_SIZE,
+)
+from celestia_tpu.da import dah as dah_mod
+from celestia_tpu.da.blob import Blob
+from celestia_tpu.da.dah import DataAvailabilityHeader
+from celestia_tpu.da.inclusion import create_commitment
+from celestia_tpu.da.namespace import Namespace
+from celestia_tpu.utils import native
+
+# pkg/da/data_availability_header_test.go:29
+MIN_DAH_HASH = bytes.fromhex(
+    "3d96b7d238e7e0456f6af8e7cdf0a67bd6cf9c2089ecb559c659dcaa1f880353"
+)
+# pkg/da/data_availability_header_test.go:45 ("typical", squareSize=2)
+DAH_2X2_HASH = bytes.fromhex(
+    "b56e4d251ac266f4b91cc5464b3fc7efcbdc888064647496d13133f0dc65ac25"
+)
+# pkg/da/data_availability_header_test.go:51 ("max square size", 128)
+DAH_128_HASH = bytes.fromhex(
+    "0bd3abeeacfbb0b92dfbdac4a154868e3c4e79666f7fcf6c620bb90dd3a0dcf0"
+)
+
+
+def _fixture_share() -> bytes:
+    """generateShare(ns1) parity: ns1 = MustNewV0(10 x 0x01), remainder
+    0xFF to ShareSize."""
+    ns1 = Namespace.v0(b"\x01" * 10)
+    share = ns1.raw + b"\xff" * (SHARE_SIZE - len(ns1.raw))
+    assert len(share) == SHARE_SIZE
+    return share
+
+
+def _fixture_shares(count: int) -> np.ndarray:
+    share = _fixture_share()
+    return np.frombuffer(share * count, dtype=np.uint8).reshape(
+        count, SHARE_SIZE
+    )
+
+
+def test_min_dah_matches_go_fixture():
+    """The empty-block data root is bit-identical to the Go stack's."""
+    dah = dah_mod.min_data_availability_header()
+    assert dah.hash == MIN_DAH_HASH
+    dah.validate_basic()
+
+
+def test_dah_2x2_matches_go_fixture():
+    """4 fixture shares through the FULL device pipeline (extend + NMT +
+    data root) produce the Go stack's exact hash."""
+    eds = dah_mod.extend_shares(_fixture_shares(4))
+    dah = dah_mod.new_data_availability_header(eds)
+    assert dah.hash == DAH_2X2_HASH
+    assert len(dah.row_roots) == 4
+    assert len(dah.col_roots) == 4
+    dah.validate_basic()
+
+
+def test_dah_128_matches_go_fixture():
+    """The max-size square (16,384 shares) matches the Go stack.
+
+    Runs on the native C++ pipeline: XLA's CPU backend needs minutes to
+    compile the unsharded k=128 program in the test environment, while
+    the native path is bit-identical to the device path (asserted at 2x2
+    in test_dah_2x2_native_matches_device below and for random squares
+    in the wider suite)."""
+    if not native.available():
+        pytest.skip("native library unavailable")
+    square = _fixture_shares(128 * 128).reshape(128, 128, SHARE_SIZE)
+    _, roots, _ = native.extend_block_cpu(square)
+    rows = tuple(roots[i].tobytes() for i in range(256))
+    cols = tuple(roots[i].tobytes() for i in range(256, 512))
+    assert DataAvailabilityHeader.compute_hash(rows, cols) == DAH_128_HASH
+
+
+def test_dah_2x2_native_matches_device():
+    """Ties the 128 vector's native leg to the device path: at 2x2 both
+    produce the same (Go-pinned) hash."""
+    if not native.available():
+        pytest.skip("native library unavailable")
+    square = _fixture_shares(4).reshape(2, 2, SHARE_SIZE)
+    _, roots, _ = native.extend_block_cpu(square)
+    rows = tuple(roots[i].tobytes() for i in range(4))
+    cols = tuple(roots[i].tobytes() for i in range(4, 8))
+    assert DataAvailabilityHeader.compute_hash(rows, cols) == DAH_2X2_HASH
+
+
+def test_nil_dah_hash_is_rfc6962_empty():
+    """data_availability_header_test.go:15-25: the nil DAH hashes to the
+    RFC-6962 empty root, sha256 of the empty string."""
+    empty = hashlib.sha256(b"").digest()
+    assert DataAvailabilityHeader.compute_hash((), ()) == empty
+
+
+def test_payforblob_commitment_construction_regression():
+    """The validMsgPayForBlobs blob (payforblob_test.go:169-188): data =
+    totalBlobSize(ContinuationSparseShareContentSize * 12) bytes of 0x02
+    under ns1, commitment via the subtree-root MMR construction
+    (payforblob_test.go:206 shape).  The Go test pins no bytes for it, so
+    this value is a self-generated regression anchor: it guards the
+    commitment construction (share split, MMR sizes, NMT subtree roots,
+    RFC-6962 fold) against silent change."""
+    size = CONTINUATION_SPARSE_SHARE_CONTENT_SIZE * 12
+    delim = 1
+    n = size
+    while n >= 0x80:  # shares.DelimLen: varint length of the size
+        n >>= 7
+        delim += 1
+    data = b"\x02" * (size - delim)
+    assert len(data) == 5782
+    commitment = create_commitment(Blob(Namespace.v0(b"\x01" * 10), data))
+    assert commitment == bytes.fromhex(
+        "3b0696ee3b902f2e2c91e338e866f4d6aa4876716dc76b91776ede1c683dbe2f"
+    )

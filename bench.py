@@ -296,10 +296,11 @@ def _filter_txs_ms(n_tx: int = 512):
     times = []
     for _ in range(3):
         # measure the COLD paths: tx construction warmed the commitment
-        # cache and a prior iteration the signature cache — either would
-        # hide codec/EC regressions
+        # cache and a prior iteration the signature/decoded-tx caches —
+        # any of them would hide codec/EC regressions
         inclusion._COMMITMENT_CACHE.clear()
         node.app._sig_cache.clear()
+        node.app._decoded_cache.clear()
         t0 = time.time()
         kept = node.app._filter_txs(txs)
         times.append((time.time() - t0) * 1000.0)
@@ -323,8 +324,9 @@ def _prepare_proposal_ms(k: int):
     times, breakdowns = [], []
     for _ in range(3):
         # This measures the PROPOSER regime: pooled txs passed CheckTx,
-        # which computes blob commitments (warm _COMMITMENT_CACHE — kept)
-        # but verifies signatures inline without touching the batch-path
+        # which computes blob commitments and records the decoded-tx
+        # verdicts (warm _COMMITMENT_CACHE + _decoded_cache — kept) but
+        # verifies signatures inline without touching the batch-path
         # sig cache (cold — cleared).  _filter_txs_ms below measures the
         # fully cold validator-receiving-a-foreign-proposal regime.
         node.app._sig_cache.clear()

@@ -41,18 +41,19 @@ from celestia_tpu.da.blob import (
 from celestia_tpu.da.namespace import (
     Namespace,
     PAY_FOR_BLOB_NAMESPACE,
+    PRIMARY_RESERVED_PADDING_NAMESPACE,
+    TAIL_PADDING_NAMESPACE,
     TRANSACTION_NAMESPACE,
 )
 from celestia_tpu.da.shares import (
+    SHARE_SIZE,
     Share,
-    namespace_padding_shares,
+    blob_shares_array,
+    padding_share,
     parse_compact_shares,
     parse_sparse_shares,
-    reserved_padding_shares,
     shares_to_array,
-    split_blob_into_shares,
     split_txs_into_shares,
-    tail_padding_shares,
 )
 
 
@@ -79,22 +80,64 @@ def next_share_index(cursor: int, blob_share_len: int, threshold: int = DEFAULT_
     return -(-cursor // width) * width
 
 
-@dataclass(frozen=True)
 class Square:
-    """An original (unextended) data square of k*k shares, row-major."""
+    """An original (unextended) data square of k*k shares, row-major.
 
-    shares: Tuple[Share, ...]
-    size: int  # width k
+    Backed by EITHER a Share tuple or a uint8[k*k, 512] array; the other
+    representation materializes lazily.  The builder's export writes the
+    array directly (one numpy pass), so the PrepareProposal hot path
+    never creates the 16k Share objects a k=128 square would need — the
+    object view exists for proofs, parsers and tests that want it.
+    """
 
-    def __post_init__(self):
-        if len(self.shares) != self.size * self.size:
-            raise ValueError(
-                f"square size {self.size} needs {self.size**2} shares, got {len(self.shares)}"
-            )
+    __slots__ = ("size", "_shares", "_array")
+
+    def __init__(
+        self,
+        shares: Optional[Sequence[Share]] = None,
+        size: int = 0,
+        array: Optional[np.ndarray] = None,
+    ):
+        if shares is None and array is None:
+            raise ValueError("Square needs shares or an array")
+        if shares is not None:
+            shares = tuple(shares)
+            if len(shares) != size * size:
+                raise ValueError(
+                    f"square size {size} needs {size**2} shares, "
+                    f"got {len(shares)}"
+                )
+        if array is not None:
+            array = np.ascontiguousarray(array, dtype=np.uint8)
+            if array.shape != (size * size, 512):
+                raise ValueError(
+                    f"square size {size} needs uint8[{size**2}, 512], "
+                    f"got {array.shape}"
+                )
+            # freeze OUR view only — ascontiguousarray may return the
+            # caller's own object, whose flags are not ours to change
+            array = array.view()
+            array.flags.writeable = False  # shared view; see to_array
+        self.size = size
+        self._shares = shares
+        self._array = array
+
+    @property
+    def shares(self) -> Tuple[Share, ...]:
+        if self._shares is None:
+            from celestia_tpu.da.shares import array_to_shares
+
+            self._shares = tuple(array_to_shares(self._array))
+        return self._shares
 
     def to_array(self) -> np.ndarray:
-        """uint8[k*k, 512] for the device extension pipeline."""
-        return shares_to_array(self.shares)
+        """uint8[k*k, 512] for the device pipeline.  Read-only: the array
+        is shared with the Square (copy before mutating)."""
+        if self._array is None:
+            arr = shares_to_array(self._shares)
+            arr.flags.writeable = False
+            self._array = arr
+        return self._array
 
     def is_empty(self) -> bool:
         return self.size == 1 and self.shares[0].namespace.is_padding()
@@ -286,36 +329,50 @@ class Builder:
             wrappers.append(IndexWrapper(tx, idxs))
             order += n_blobs
 
-        shares: List[Share] = []
+        # One numpy pass straight into the square tensor: compact shares
+        # (small count) via the Share path, blob sequences via the
+        # vectorized splitter, padding by broadcast — no per-share Python
+        # objects (16k of them at k=128 dominated the build phase).
+        compact: List[Share] = []
         if self.txs:
-            shares.extend(split_txs_into_shares(TRANSACTION_NAMESPACE, self.txs))
+            compact.extend(split_txs_into_shares(TRANSACTION_NAMESPACE, self.txs))
         if wrappers:
-            shares.extend(
+            compact.extend(
                 split_txs_into_shares(
                     PAY_FOR_BLOB_NAMESPACE, [w.marshal() for w in wrappers]
                 )
             )
-        assert len(shares) == n_tx + n_pfb, "compact share count drifted from layout"
+        assert len(compact) == n_tx + n_pfb, "compact share count drifted from layout"
 
-        cursor = len(shares)
+        arr = np.zeros((size * size, SHARE_SIZE), dtype=np.uint8)
+        if compact:
+            arr[: len(compact)] = np.frombuffer(
+                b"".join(s.raw for s in compact), dtype=np.uint8
+            ).reshape(len(compact), SHARE_SIZE)
+        cursor = len(compact)
         prev_ns: Optional[Namespace] = None
         for p in placed:
             if p.start > cursor:
-                pad_ns = prev_ns
-                if pad_ns is None:
-                    shares.extend(reserved_padding_shares(p.start - cursor))
-                else:
-                    shares.extend(namespace_padding_shares(pad_ns, p.start - cursor))
-            blob_shares = split_blob_into_shares(
+                pad_ns = (
+                    prev_ns
+                    if prev_ns is not None
+                    else PRIMARY_RESERVED_PADDING_NAMESPACE
+                )
+                arr[cursor : p.start] = np.frombuffer(
+                    padding_share(pad_ns).raw, dtype=np.uint8
+                )
+            blob_arr = blob_shares_array(
                 p.blob.namespace, p.blob.data, p.blob.share_version
             )
-            shares.extend(blob_shares)
-            cursor = p.start + len(blob_shares)
+            arr[p.start : p.start + blob_arr.shape[0]] = blob_arr
+            cursor = p.start + blob_arr.shape[0]
             prev_ns = p.blob.namespace
-        if len(shares) < size * size:
-            shares.extend(tail_padding_shares(size * size - len(shares)))
+        if cursor < size * size:
+            arr[cursor:] = np.frombuffer(
+                padding_share(TAIL_PADDING_NAMESPACE).raw, dtype=np.uint8
+            )
 
-        return Square(tuple(shares), size), list(self.block_txs), wrappers
+        return Square(size=size, array=arr), list(self.block_txs), wrappers
 
 
 def build(

@@ -146,6 +146,15 @@ class App:
 
         self._sig_cache: "OrderedDict[bytes, bool]" = OrderedDict()
         self._sig_cache_max = 8192
+        # validated-tx cache (tx-bytes hash -> (tx, raw_inner)), bounded
+        # LRU: BlobTx validation recomputes every blob's share commitment
+        # — deterministic in the raw bytes, so CheckTx's verdict is
+        # reusable verbatim in Prepare/Process for the same bytes (the
+        # reference revalidates at each point; caching by exact bytes is
+        # the consensus-safe shortcut).  Values hold only the parsed
+        # inner tx (commitments, no blob payloads), so entries are small.
+        self._decoded_cache: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._decoded_cache_max = 8192
 
     def _wire_keepers(self, rebuild_ibc: bool = True) -> None:
         """Re-point every keeper at the current self.store.
@@ -289,6 +298,15 @@ class App:
                     tx = unmarshal_tx(btx.tx)
                 else:
                     tx = validate_blob_tx(btx, self.chain_id)
+                    # the verdict is deterministic in the raw bytes:
+                    # Prepare/Process reuse it instead of re-hashing the
+                    # blob payloads (check_tx.go validates, then the
+                    # proposal paths validate the same bytes again)
+                    import hashlib as _hashlib
+
+                    self._remember_decoded(
+                        _hashlib.sha256(raw).digest(), tx, btx.tx
+                    )
                 raw_inner = btx.tx
             else:
                 tx = unmarshal_tx(raw)
@@ -342,10 +360,23 @@ class App:
         proves the same signature check.  (CheckTx verifies inline in
         the ante chain and does not populate this cache.)
         """
+        import hashlib as _hashlib
+
         from celestia_tpu.utils.secp256k1 import verify_batch
 
+        # ONE full-data hash per tx, shared by the decoded-tx cache and
+        # the signature cache (the raw bytes are the dominant hash cost
+        # for blob txs).
         decoded: List[tuple] = []
+        tx_keys: List[bytes] = []
         for raw in txs:
+            key = _hashlib.sha256(raw).digest()
+            tx_keys.append(key)
+            hit = self._decoded_cache.get(key)
+            if hit is not None:
+                self._decoded_cache.move_to_end(key)
+                decoded.append((raw, hit[0], hit[1], None))
+                continue
             btx = unmarshal_blob_tx(raw)
             try:
                 if btx is not None:
@@ -360,6 +391,7 @@ class App:
                         raise AnteError("PFB without blobs")
                     raw_inner = raw
                 decoded.append((raw, tx, raw_inner, None))
+                self._remember_decoded(key, tx, raw_inner)
             except (AnteError, ValueError) as e:
                 decoded.append((raw, None, None, e))
         # single-key txs batch-verify natively; multisig txs fall back to
@@ -368,17 +400,14 @@ class App:
         # to True, each distinct fresh key is verified once (duplicates
         # dedupe), and the output loop reads ONLY batch_ok — immune to
         # LRU evictions _remember_sig performs mid-call.
-        import hashlib as _hashlib
-
         batch_ok: Dict[bytes, Optional[bool]] = {}
         keys: List[Optional[bytes]] = []
         live: List[tuple] = []
         live_keys: List[bytes] = []
-        for d in decoded:
+        for d, key in zip(decoded, tx_keys):
             if d[1] is None or d[1].is_multisig():
                 keys.append(None)
                 continue
-            key = _hashlib.sha256(d[0]).digest()
             keys.append(key)
             if key in batch_ok:
                 continue
@@ -415,6 +444,12 @@ class App:
         self._sig_cache.move_to_end(key)
         while len(self._sig_cache) > self._sig_cache_max:
             self._sig_cache.popitem(last=False)
+
+    def _remember_decoded(self, key: bytes, tx, raw_inner: bytes) -> None:
+        self._decoded_cache[key] = (tx, raw_inner)
+        self._decoded_cache.move_to_end(key)
+        while len(self._decoded_cache) > self._decoded_cache_max:
+            self._decoded_cache.popitem(last=False)
 
     def _filter_txs(self, txs: List[bytes]) -> List[bytes]:
         """FilterTxs parity (validate_txs.go:29-97): run the ante chain over

@@ -66,6 +66,10 @@ class Manager:
 
     def __init__(self, modules: Sequence[VersionedModule] = ()):
         self._modules: List[VersionedModule] = []
+        # version -> accepted msg-type frozenset; the gatekeeper asks
+        # once per tx, so this is recomputed only when the module set
+        # changes (frozen: the cached object is handed out directly)
+        self._accept_cache: Dict[int, frozenset] = {}
         for m in modules:
             self.register(m)
 
@@ -84,6 +88,7 @@ class Manager:
                     f"module {module.name}: overlapping version ranges"
                 )
         self._modules.append(module)
+        self._accept_cache.clear()
 
     def unregister(self, name: str, from_version: Optional[int] = None) -> None:
         self._modules = [
@@ -94,6 +99,7 @@ class Manager:
                 and (from_version is None or m.from_version == from_version)
             )
         ]
+        self._accept_cache.clear()
 
     def modules_at(self, version: int) -> List[VersionedModule]:
         return [m for m in self._modules if m.active_at(version)]
@@ -109,14 +115,19 @@ class Manager:
                 bounds.add(m.to_version)
         return sorted(v for v in bounds if self.modules_at(v))
 
-    def msgs_accepted_at(self, version: int) -> Set[type]:
+    def msgs_accepted_at(self, version: int) -> frozenset:
+        cached = self._accept_cache.get(version)
+        if cached is not None:
+            return cached
         active = self.modules_at(version)
         if version not in self.supported_versions():
             raise ValueError(f"unsupported app version {version}")
         out: Set[type] = set()
         for m in active:
             out.update(m.msg_types)
-        return out
+        frozen = frozenset(out)
+        self._accept_cache[version] = frozen
+        return frozen
 
     def run_migrations(self, app, from_version: int, to_version: int) -> List[str]:
         """RunMigrations parity (module.go:231): step through every version

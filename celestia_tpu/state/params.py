@@ -20,21 +20,40 @@ from celestia_tpu.appconsts import (
 from celestia_tpu.state.store import KVStore
 
 
+_ABSENT = object()  # memoized "key not in store" (distinct from stored null)
+
+
 class ParamsKeeper:
     def __init__(self, store: KVStore):
         self.store = store
+        # read-through memo: the ante chain reads the same few params for
+        # every tx in a proposal; decode each once per keeper instance.
+        # Writes go through set() (which invalidates), and every branch
+        # swap builds a fresh keeper, so the memo cannot go stale.
+        self._memo: Dict[Tuple[str, str], Any] = {}
 
     def _key(self, subspace: str, key: str) -> bytes:
         return f"{subspace}/{key}".encode()
 
     def set(self, subspace: str, key: str, value: Any) -> None:
         self.store.set(self._key(subspace, key), json.dumps(value).encode())
+        self._memo.pop((subspace, key), None)
 
     def get(self, subspace: str, key: str, default: Any = None) -> Any:
-        raw = self.store.get(self._key(subspace, key))
-        if raw is None:
+        mk = (subspace, key)
+        if mk in self._memo:
+            val = self._memo[mk]
+        else:
+            raw = self.store.get(self._key(subspace, key))
+            val = _ABSENT if raw is None else json.loads(raw.decode())
+            self._memo[mk] = val
+        if val is _ABSENT:
             return default
-        return json.loads(raw.decode())
+        if isinstance(val, (list, dict)):
+            # callers may mutate their copy; the memo (and therefore
+            # later reads) must keep matching the committed store
+            return json.loads(json.dumps(val))
+        return val
 
     def has(self, subspace: str, key: str) -> bool:
         return self.store.has(self._key(subspace, key))

@@ -768,11 +768,19 @@ class Tx:
         return bytes(out)
 
     def sign_bytes(self, chain_id: str) -> bytes:
+        # memoized: decoded Tx objects are cached across CheckTx/Prepare/
+        # Process (state/app.py _decoded_cache), and each stage re-derives
+        # the same digest; the object is immutable so the digest is too
+        cached = getattr(self, "_sign_bytes_memo", None)
+        if cached is not None and cached[0] == chain_id:
+            return cached[1]
         out = bytearray()
         _put_bytes(out, chain_id.encode())
         _put_bytes(out, self.body_bytes())
         _put_bytes(out, self.auth_bytes())
-        return hashlib.sha256(bytes(out)).digest()
+        digest = hashlib.sha256(bytes(out)).digest()
+        object.__setattr__(self, "_sign_bytes_memo", (chain_id, digest))
+        return digest
 
     def signed(self, priv: PrivateKey, chain_id: str) -> "Tx":
         sig = priv.sign(self.sign_bytes(chain_id))

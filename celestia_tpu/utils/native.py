@@ -22,6 +22,7 @@ _SO = _REPO_ROOT / "native" / "celestia_native.so"
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_has_glv = False
 
 
 def _build() -> bool:
@@ -75,6 +76,15 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.secp256k1_ecmul_double_batch.argtypes = [
         u8p, u8p, u8p, ctypes.c_int, u8p, u8p, ctypes.c_int,
     ]
+    global _has_glv
+    try:
+        lib.secp256k1_ecmul_double_glv_batch.argtypes = [
+            u8p, u8p, u8p, ctypes.c_int, u8p, u8p, ctypes.c_int,
+        ]
+        _has_glv = True
+    except AttributeError:
+        # stale .so without the GLV symbol: degrade to the plain path
+        _has_glv = False
     _lib = lib
     return _lib
 
@@ -245,5 +255,35 @@ def ecmul_double_batch(
     ok = np.zeros(n, dtype=np.uint8)
     lib.secp256k1_ecmul_double_batch(
         _ptr(u1s), _ptr(u2s), _ptr(pubs), n, _ptr(out_x), _ptr(ok), nthreads
+    )
+    return ok, out_x
+
+
+def has_glv() -> bool:
+    return _load() is not None and _has_glv
+
+
+def ecmul_double_glv_batch(
+    ks: np.ndarray, signs: np.ndarray, pubs: np.ndarray, nthreads: int = 0
+):
+    """Threaded batch of GLV-split double multiplications.
+
+    ks: uint8[n, 128] — four 32-byte big-endian scalar magnitudes per
+    verify (|k1_G|, |k2_G|, |k1_Q|, |k2_Q| from utils.secp256k1._glv_split);
+    signs: uint8[n, 4] (1 = negative component); pubs: uint8[n, 64]
+    UNCOMPRESSED affine keys (x||y big-endian).
+    Returns (ok uint8[n], x uint8[n, 32]).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    ks = np.ascontiguousarray(ks, dtype=np.uint8)
+    signs = np.ascontiguousarray(signs, dtype=np.uint8)
+    pubs = np.ascontiguousarray(pubs, dtype=np.uint8)
+    n = ks.shape[0]
+    out_x = np.zeros((n, 32), dtype=np.uint8)
+    ok = np.zeros(n, dtype=np.uint8)
+    lib.secp256k1_ecmul_double_glv_batch(
+        _ptr(ks), _ptr(signs), _ptr(pubs), n, _ptr(out_x), _ptr(ok), nthreads
     )
     return ok, out_x

@@ -266,6 +266,45 @@ def _verify_scalars(msg: bytes, sig: bytes):
     return r, z * w % N, r * w % N
 
 
+# --- GLV endomorphism (verification speedup) -------------------------------
+# secp256k1 has an efficient endomorphism phi(x, y) = (beta*x, y) with
+# phi(P) = lambda*P (beta, lambda the matching cube roots of unity mod p
+# and mod N).  Splitting a 256-bit scalar k into k1 + k2*lambda with
+# |k1|, |k2| ~ 2^128 (lattice rounding below, the standard GLV basis)
+# halves the doubling count of the native wNAF loop.  The split runs here
+# in Python (CPython bigints), the point math in native C++.
+GLV_LAMBDA = 0x5363AD4CC05C30E0A5261C028812645A122E22EA20816678DF02967C1B23BD72
+_GLV_A1 = 0x3086D221A7D46BCDE86C90E49284EB15
+_GLV_B1 = -0xE4437ED6010E88286F547FA90ABFE4C3
+_GLV_A2 = 0x114CA50F7A8E2F3F657C1108D9D44CFD8
+_GLV_B2 = _GLV_A1
+
+
+def _glv_split(k: int):
+    """k -> (k1, k2) with k1 + k2*GLV_LAMBDA ≡ k (mod N), both ~128-bit."""
+    c1 = (_GLV_B2 * k + N // 2) // N
+    c2 = (-_GLV_B1 * k + N // 2) // N
+    k1 = k - c1 * _GLV_A1 - c2 * _GLV_A2
+    k2 = -c1 * _GLV_B1 - c2 * _GLV_B2
+    return k1, k2
+
+
+def _batch_inv(vals, mod):
+    """Montgomery's trick: invert every element with ONE modular
+    inversion plus 3(n-1) multiplications.  All vals must be non-zero
+    mod ``mod`` (signature s-values are range-checked before this)."""
+    n = len(vals)
+    prefix = [1] * (n + 1)
+    for i, v in enumerate(vals):
+        prefix[i + 1] = prefix[i] * v % mod
+    acc = _inv(prefix[n], mod)
+    out = [0] * n
+    for i in range(n - 1, -1, -1):
+        out[i] = prefix[i] * acc % mod
+        acc = acc * vals[i] % mod
+    return out
+
+
 def _ecmul_double(u1: int, u2: int, pub: "PublicKey"):
     """u1*G + u2*pub — native C when available, pure Python otherwise."""
     from celestia_tpu.utils import native
@@ -278,6 +317,15 @@ def _ecmul_double(u1: int, u2: int, pub: "PublicKey"):
             return None
         return int.from_bytes(got[0], "big"), int.from_bytes(got[1], "big")
     return _point_add(_point_mul(u1, (Gx, Gy)), _point_mul(u2, (pub.x, pub.y)))
+
+
+@lru_cache(maxsize=4096)
+def _uncompressed64(raw: bytes):
+    """compressed(33B) -> uncompressed(64B x||y) for the native GLV path;
+    memoized on top of the memoized sqrt decompression.  Raises
+    ValueError for invalid encodings (like from_compressed)."""
+    pk = _decompress_cached(raw)
+    return pk.x.to_bytes(32, "big") + pk.y.to_bytes(32, "big")
 
 
 def verify_batch(msgs, sigs, pubkeys) -> list:
@@ -307,24 +355,73 @@ def verify_batch(msgs, sigs, pubkeys) -> list:
         return out
 
     results = [False] * n
-    u1s = np.zeros((n, 32), dtype=np.uint8)
-    u2s = np.zeros((n, 32), dtype=np.uint8)
     pubs = np.zeros((n, 33), dtype=np.uint8)
     rs = [0] * n
     live = np.zeros(n, dtype=bool)
+    # Montgomery batch inversion: ONE modular inversion for the whole
+    # batch instead of one per signature (the per-sig s^-1 was a visible
+    # slice of FilterTxs host time at proposal scale)
+    pre_rsz: list = [None] * n
+    s_vals: list = []
     for i, (msg, sig, raw) in enumerate(zip(msgs, sigs, pubkeys)):
-        pre = _verify_scalars(msg, sig)
-        if pre is None or len(raw) != 33 or raw[0] not in (2, 3):
+        if len(sig) != 64 or len(raw) != 33 or raw[0] not in (2, 3):
             continue
-        r, u1, u2 = pre
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (1 <= r < N and 1 <= s < N) or s > N // 2:
+            continue  # low-s rule: see _verify_scalars
+        z = int.from_bytes(hashlib.sha256(msg).digest(), "big") % N
+        pre_rsz[i] = (r, s, z, len(s_vals))
+        s_vals.append(s)
+    if s_vals:
+        ws = _batch_inv(s_vals, N)
+    use_glv = native.has_glv()
+    if use_glv:
+        # GLV path wants UNCOMPRESSED keys (decompression costs a field
+        # sqrt; senders repeat, so the cache amortizes it to ~zero)
+        pubs = np.zeros((n, 64), dtype=np.uint8)
+        ks = np.zeros((n, 128), dtype=np.uint8)
+        sgn = np.zeros((n, 4), dtype=np.uint8)
+    else:
+        u1s = np.zeros((n, 32), dtype=np.uint8)
+        u2s = np.zeros((n, 32), dtype=np.uint8)
+    for i in range(n):
+        pre = pre_rsz[i]
+        if pre is None:
+            continue
+        r, s, z, j = pre
+        w = ws[j]
+        u1 = z * w % N
+        u2 = r * w % N
         rs[i] = r
-        u1s[i] = np.frombuffer(u1.to_bytes(32, "big"), dtype=np.uint8)
-        u2s[i] = np.frombuffer(u2.to_bytes(32, "big"), dtype=np.uint8)
-        pubs[i] = np.frombuffer(raw, dtype=np.uint8)
+        if use_glv:
+            try:
+                raw_pub = _uncompressed64(bytes(pubkeys[i]))
+            except ValueError:
+                continue  # invalid pubkey: signature cannot verify
+            k1, k2 = _glv_split(u1)
+            k3, k4 = _glv_split(u2)
+            ks[i] = np.frombuffer(
+                abs(k1).to_bytes(32, "big") + abs(k2).to_bytes(32, "big")
+                + abs(k3).to_bytes(32, "big") + abs(k4).to_bytes(32, "big"),
+                dtype=np.uint8,
+            )
+            sgn[i, 0] = k1 < 0
+            sgn[i, 1] = k2 < 0
+            sgn[i, 2] = k3 < 0
+            sgn[i, 3] = k4 < 0
+            pubs[i] = np.frombuffer(raw_pub, dtype=np.uint8)
+        else:
+            u1s[i] = np.frombuffer(u1.to_bytes(32, "big"), dtype=np.uint8)
+            u2s[i] = np.frombuffer(u2.to_bytes(32, "big"), dtype=np.uint8)
+            pubs[i] = np.frombuffer(pubkeys[i], dtype=np.uint8)
         live[i] = True
     if not live.any():
         return results
-    ok, xs = native.ecmul_double_batch(u1s, u2s, pubs)
+    if use_glv:
+        ok, xs = native.ecmul_double_glv_batch(ks, sgn, pubs)
+    else:
+        ok, xs = native.ecmul_double_batch(u1s, u2s, pubs)
     for i in range(n):
         if live[i] and ok[i]:
             results[i] = int.from_bytes(xs[i].tobytes(), "big") % N == rs[i]

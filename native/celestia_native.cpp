@@ -755,8 +755,13 @@ static int jac_to_aff(Aff& r, const Jac& p) {
 }
 
 // --- fixed G table: odd multiples 1G, 3G, ..., 255G (wNAF window 8) ---
+// plus the same table mapped through the GLV endomorphism phi(x, y) =
+// (beta*x, y), where beta is a primitive cube root of unity mod p:
+// lambda*(x, y) = phi(x, y) for the matching cube root lambda mod n.
 
 static Aff G_TAB[128];
+static Aff PHI_G_TAB[128];
+static Fe FE_BETA;
 static int g_tab_ready = 0;
 
 static void secp_init(void) {
@@ -769,6 +774,11 @@ static void secp_init(void) {
         0x48, 0x3A, 0xDA, 0x77, 0x26, 0xA3, 0xC4, 0x65, 0x5D, 0xA4, 0xFB,
         0xFC, 0x0E, 0x11, 0x08, 0xA8, 0xFD, 0x17, 0xB4, 0x48, 0xA6, 0x85,
         0x54, 0x19, 0x9C, 0x47, 0xD0, 0x8F, 0xFB, 0x10, 0xD4, 0xB8};
+    static const uint8_t BETA[32] = {
+        0x7A, 0xE9, 0x6A, 0x2B, 0x65, 0x7C, 0x07, 0x10, 0x6E, 0x64, 0x47,
+        0x9E, 0xAC, 0x34, 0x34, 0xE9, 0x9C, 0xF0, 0x49, 0x75, 0x12, 0xF5,
+        0x89, 0x95, 0xC1, 0x39, 0x6C, 0x28, 0x71, 0x95, 0x01, 0xEE};
+    fe_from_bytes(FE_BETA, BETA);
     Jac g;
     fe_from_bytes(g.x, GX);
     fe_from_bytes(g.y, GY);
@@ -778,6 +788,8 @@ static void secp_init(void) {
     Jac cur = g;
     for (int i = 0; i < 128; i++) {
         jac_to_aff(G_TAB[i], cur);
+        fe_mul(PHI_G_TAB[i].x, G_TAB[i].x, FE_BETA);
+        PHI_G_TAB[i].y = G_TAB[i].y;
         jac_add(cur, cur, g2);
     }
     g_tab_ready = 1;
@@ -891,6 +903,146 @@ int secp256k1_ecmul_double(const uint8_t* u1_be, const uint8_t* u2_be,
     fe_to_bytes(out_x, ra.x);
     fe_to_bytes(out_y, ra.y);
     return 1;
+}
+
+// GLV double-multiplication: u1*G + u2*Q with both scalars pre-split by
+// the caller (Python bigints do the lattice rounding) into half-length
+// components u = k1 + k2*lambda (mod n), |k1|,|k2| ~ 2^128.  The joint
+// wNAF loop then runs ~128 doublings instead of ~256 — the dominant cost
+// of the non-GLV path — against four tables: G, phi(G) (both static),
+// Q and phi(Q) (built per call, normalized to affine with one shared
+// Montgomery inversion so every addition is the cheap mixed form).
+//
+// ks: 4 scalars of 32 bytes big-endian (|k1_G|, |k2_G|, |k1_Q|, |k2_Q|);
+// signs: 4 bytes, 1 = that component is negative (fold into the digit's
+// point sign).  Verification-only, like everything here.
+static int ecmul_double_glv_core(const uint8_t* ks, const uint8_t* signs,
+                                 const uint8_t* pub64, Jac& out) {
+    // pub64: uncompressed affine (x||y, 32+32 big-endian) — the caller
+    // decompresses once per distinct key (cached Python-side), saving the
+    // ~sqrt-sized field exponentiation every verify paid before.
+    Aff q;
+    fe_from_bytes(q.x, pub64);
+    fe_from_bytes(q.y, pub64 + 32);
+    if (fe_cmp(q.x, FE_P) >= 0 || fe_cmp(q.y, FE_P) >= 0) return 0;
+    {
+        // on-curve check (y^2 == x^3 + 7): cheap insurance that a bad
+        // uncompressed encoding can never validate a signature
+        Fe y2, x3, t;
+        fe_sqr(y2, q.y);
+        fe_sqr(t, q.x);
+        fe_mul(x3, t, q.x);
+        Fe seven = {{7, 0, 0, 0}};
+        fe_add(x3, x3, seven);
+        if (fe_cmp(y2, x3) != 0) return 0;
+    }
+    // odd multiples 1Q..15Q (w = 5), Jacobian (an affine normalization
+    // would cost a field inversion per call — more than it saves), plus
+    // the endomorphism image: phi(X:Y:Z) = (beta*X : Y : Z)
+    Jac qt[8], pqt[8];
+    qt[0].x = q.x;
+    qt[0].y = q.y;
+    qt[0].z = {{1, 0, 0, 0}};
+    Jac q2;
+    jac_dbl(q2, qt[0]);
+    for (int i = 1; i < 8; i++) jac_add(qt[i], qt[i - 1], q2);
+    for (int i = 0; i < 8; i++) {
+        fe_mul(pqt[i].x, qt[i].x, FE_BETA);
+        pqt[i].y = qt[i].y;
+        pqt[i].z = qt[i].z;
+    }
+    // sized for FULL 256-bit scalars (like the non-GLV path): the caller
+    // contract is ~128-bit split components, but an exported symbol must
+    // not turn a fat scalar into a stack smash
+    int8_t d[4][260];
+    int len[4];
+    len[0] = wnaf_encode(ks + 0, 8, d[0]);
+    len[1] = wnaf_encode(ks + 32, 8, d[1]);
+    len[2] = wnaf_encode(ks + 64, 5, d[2]);
+    len[3] = wnaf_encode(ks + 96, 5, d[3]);
+    int maxlen = 0;
+    for (int j = 0; j < 4; j++)
+        if (len[j] > maxlen) maxlen = len[j];
+    Jac r = JAC_INF;
+    for (int i = maxlen - 1; i >= 0; i--) {
+        jac_dbl(r, r);
+        for (int j = 0; j < 2; j++) {
+            if (i >= len[j] || !d[j][i]) continue;
+            int8_t dg = d[j][i];
+            Aff a = (j == 0 ? G_TAB : PHI_G_TAB)[(dg > 0 ? dg : -dg) >> 1];
+            // component sign XOR digit sign picks the point's sign
+            if ((dg < 0) != (signs[j] != 0)) fe_neg(a.y, a.y);
+            jac_add_aff(r, r, a);
+        }
+        for (int j = 2; j < 4; j++) {
+            if (i >= len[j] || !d[j][i]) continue;
+            int8_t dg = d[j][i];
+            Jac p = (j == 2 ? qt : pqt)[(dg > 0 ? dg : -dg) >> 1];
+            if ((dg < 0) != (signs[j] != 0)) fe_neg(p.y, p.y);
+            jac_add(r, r, p);
+        }
+    }
+    if (jac_is_inf(r)) return 0;
+    out = r;
+    return 1;
+}
+
+// Batched GLV double-multiplication across worker threads.
+// ks: n*128 (four 32-byte components per verify); signs: n*4;
+// pubs: n*64 UNCOMPRESSED affine keys.  The final Jacobian->affine
+// normalization is batched per thread (one field inversion for the whole
+// stripe via Montgomery's trick) — per-call inversions were a visible
+// fixed cost of each verification.
+void secp256k1_ecmul_double_glv_batch(const uint8_t* ks, const uint8_t* signs,
+                                      const uint8_t* pubs, int n,
+                                      uint8_t* out_x, uint8_t* ok,
+                                      int nthreads) {
+    secp_init();
+    if (nthreads <= 0) {
+        nthreads = (int)std::thread::hardware_concurrency();
+        if (nthreads <= 0) nthreads = 1;
+    }
+    if (nthreads > n) nthreads = n > 0 ? n : 1;
+    auto work = [&](int t) {
+        // the thread's results stay Jacobian until one shared inversion
+        std::vector<Jac> rs;
+        std::vector<int> idx;
+        for (int i = t; i < n; i += nthreads) {
+            Jac r;
+            if (ecmul_double_glv_core(ks + (size_t)i * 128,
+                                      signs + (size_t)i * 4,
+                                      pubs + (size_t)i * 64, r)) {
+                rs.push_back(r);
+                idx.push_back(i);
+            } else {
+                ok[i] = 0;
+            }
+        }
+        size_t m = rs.size();
+        if (!m) return;
+        std::vector<Fe> pref(m + 1);
+        pref[0] = {{1, 0, 0, 0}};
+        for (size_t i = 0; i < m; i++) fe_mul(pref[i + 1], pref[i], rs[i].z);
+        Fe acc;
+        fe_inv(acc, pref[m]);
+        for (size_t i = m; i-- > 0;) {
+            Fe zinv, zi2;
+            fe_mul(zinv, pref[i], acc);
+            fe_mul(acc, acc, rs[i].z);
+            fe_sqr(zi2, zinv);
+            Fe x;
+            fe_mul(x, rs[i].x, zi2);
+            fe_to_bytes(out_x + (size_t)idx[i] * 32, x);
+            ok[idx[i]] = 1;
+        }
+    };
+    if (nthreads == 1) {
+        work(0);
+    } else {
+        std::vector<std::thread> ts;
+        for (int t = 0; t < nthreads; t++) ts.emplace_back(work, t);
+        for (auto& th : ts) th.join();
+    }
 }
 
 // Batched double-multiplication across worker threads.

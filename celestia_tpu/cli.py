@@ -259,6 +259,20 @@ def cmd_start(args) -> int:
         ),
     )
     server.start()
+    gossip = None
+    if getattr(args, "peers", None) and getattr(args, "bft_valset", None):
+        # p2p mesh mode: flood consensus messages directly between
+        # validators, run own round timers, gossip txs want/have — the
+        # bft-relay becomes an optional observer (node/gossip.py)
+        from celestia_tpu.node.gossip import GossipEngine
+
+        gossip = GossipEngine(
+            node,
+            [a for a in args.peers.split(",") if a],
+            block_gap_s=cfg.consensus.block_interval_s,
+        )
+        gossip.start()
+        log.info("gossip mesh enabled", peers=len(gossip.peer_addrs))
     log.info(
         "node started",
         chain_id=node.chain_id,
@@ -271,6 +285,8 @@ def cmd_start(args) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         log.info("shutting down")
+        if gossip is not None:
+            gossip.stop()
         server.stop()
     return 0
 
@@ -715,6 +731,13 @@ def build_parser() -> argparse.ArgumentParser:
              '([{"address","pubkey","power"}]); this node prevotes/'
              "precommits with its key and commits only on a 2/3 quorum "
              "it verified itself (a bft-relay shuttles messages)",
+    )
+    sp.add_argument(
+        "--peers", default=None,
+        help="p2p gossip mesh (with --bft-valset): comma-separated peer "
+             "validator gRPC addresses; consensus messages flood "
+             "directly between validators with own round timers — no "
+             "relay needed",
     )
     sp.set_defaults(fn=cmd_start)
 

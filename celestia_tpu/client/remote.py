@@ -175,6 +175,23 @@ class RemoteNode:
     def bft_catchup(self, decided_wire: dict) -> bool:
         return bool(self._call_json("BftCatchup", decided_wire)["ok"])
 
+    # -- p2p gossip mesh surface (node/gossip.py) -----------------------
+
+    def gossip_msg(self, payload: dict) -> bool:
+        """Deliver a flooded consensus message: {"id", "wire", "sender"}."""
+        return bool(self._call_json("GossipMsg", payload).get("new"))
+
+    def tx_have(self, hashes) -> list:
+        """Announce pooled tx hashes; returns the subset the peer wants."""
+        out = self._call_json(
+            "TxHave", {"hashes": [h.hex() for h in hashes]}
+        )
+        return [bytes.fromhex(h) for h in out.get("want", [])]
+
+    def tx_push(self, raws) -> int:
+        out = self._call_json("TxPush", {"txs": [r.hex() for r in raws]})
+        return int(out.get("admitted", 0))
+
     def wait_for_height(self, h: int, timeout_s: float = 60.0) -> None:
         deadline = time.time() + timeout_s
         while self.height < h:

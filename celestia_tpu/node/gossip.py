@@ -1,0 +1,477 @@
+"""P2P gossip mesh for the two-phase BFT validator tier (VERDICT r3 #4).
+
+Flood-with-dedup of consensus messages plus content-addressed want/have
+transaction admission between validator processes, driven by node-local
+wall-clock timers — no central relay in the critical path.  Each
+validator process runs one :class:`GossipEngine`:
+
+- **Consensus flood.**  The engine drains its own BFT engine's outbox
+  and floods every message to its peers; a received message is delivered
+  to the local engine once (dedup by locally-computed content hash —
+  never by a sender-supplied id, which a malicious relayer could use to
+  poison the dedup set and censor real messages) and re-flooded to the
+  other peers.  With N validators the mesh is fully connected here
+  (production meshes sparsify; flood+dedup is the correctness core
+  either way).
+- **Per-peer sender threads.**  Every peer gets its own outbound queue
+  and worker; a hung or black-holed peer blocks only its own link,
+  never the pump loop or the round timers.
+- **Own timers.**  Tendermint's liveness comes from timeouts; the engine
+  schedules each requested (step, height, round) timeout on its own wall
+  clock with the standard round-escalating duration, so a dead peer or
+  a dead relay never freezes the round clock (timers fire FIRST in the
+  pump, before any RPC work).
+- **Want/have tx gossip** (specs/cat_pool.md "Gossip"): a pooled tx is
+  ANNOUNCED by hash; peers reply with the subset they lack; only those
+  raw bytes are pushed, and the receiver re-announces onward.  A pushed
+  tx that fails CheckTx is NOT marked seen — admission can succeed later
+  (e.g. a sequence gap fills), and the periodic full-pool re-announce
+  heals any such gap.
+- **Certificate-verified catch-up.**  A validator that sees traffic for
+  heights ahead of its own pulls the decided blocks from peers and
+  adopts them ONLY after verifying the 2/3 precommit certificate
+  (``bft_catchup`` -> ``engine.adopt_decision``) — peers are untrusted.
+
+The ``bft-relay`` CLI demotes to bootstrap/observer: kill it mid-run and
+the mesh keeps committing (tests/test_gossip_mesh.py).
+
+Reference role: celestia-core's p2p reactors — consensus gossip + the
+CAT mempool protocol (SURVEY §2.2 consensus engine row;
+/root/reference's specs/src/specs/cat_pool.md).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time as _time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple
+
+
+def wire_id(wire: dict) -> bytes:
+    """Content address of a consensus wire message (dedup key)."""
+    return hashlib.sha256(
+        json.dumps(wire, sort_keys=True).encode()
+    ).digest()
+
+
+class _SeenSet:
+    """Bounded insertion-ordered membership set (flood dedup)."""
+
+    def __init__(self, maxlen: int = 65536):
+        self._d: "OrderedDict[bytes, bool]" = OrderedDict()
+        self._maxlen = maxlen
+        self._lock = threading.Lock()
+
+    def add(self, key: bytes) -> bool:
+        """True if newly added, False if already present."""
+        with self._lock:
+            if key in self._d:
+                return False
+            self._d[key] = True
+            while len(self._d) > self._maxlen:
+                self._d.popitem(last=False)
+            return True
+
+    def __contains__(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._d
+
+
+class _PeerLink:
+    """One peer's outbound lane: a bounded queue + worker thread.  All
+    RPCs to this peer happen here, so a hung peer stalls only itself."""
+
+    def __init__(self, engine: "GossipEngine", addr: str, maxlen: int = 4096):
+        self.engine = engine
+        self.addr = addr
+        self._q: deque = deque(maxlen=maxlen)  # drop-oldest on overflow
+        self._event = threading.Event()
+        self._stop = threading.Event()
+        self._client = None
+        self._thread = threading.Thread(
+            target=self._run, name=f"gossip-peer-{addr}", daemon=True
+        )
+        self._thread.start()
+
+    def send(self, kind: str, data) -> None:
+        self._q.append((kind, data))
+        self._event.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._event.set()
+        self._thread.join(timeout=5)
+
+    def _ensure_client(self):
+        if self._client is None:
+            from celestia_tpu.client.remote import RemoteNode
+
+            try:
+                self._client = RemoteNode(
+                    self.addr, timeout_s=self.engine.client_timeout_s
+                )
+            except Exception:
+                self._client = None
+        return self._client
+
+    def _drop_client(self) -> None:
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:
+                pass
+            self._client = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if not self._q:
+                self._event.wait(timeout=0.2)
+                self._event.clear()
+                continue
+            try:
+                kind, data = self._q.popleft()
+            except IndexError:
+                continue
+            cli = self._ensure_client()
+            if cli is None:
+                continue  # peer down; the item is dropped (flood re-sends)
+            try:
+                if kind == "msg":
+                    cli.gossip_msg(data)
+                elif kind == "announce":
+                    hashes, by_hash = data
+                    want = cli.tx_have(hashes)
+                    if want:
+                        cli.tx_push(
+                            [by_hash[h] for h in want if h in by_hash]
+                        )
+            except Exception:
+                self._drop_client()
+
+
+class GossipEngine:
+    """One validator process's p2p overlay: floods consensus messages,
+    runs the round timers, gossips txs want/have, and self-paces block
+    production.  Attach to a BFT-enabled TestNode; the NodeServer routes
+    the Gossip*/Tx* RPCs here via ``node.gossip_engine``."""
+
+    def __init__(
+        self,
+        node,
+        peer_addrs: List[str],
+        *,
+        tick_s: float = 0.02,
+        base_timeout_s: float = 0.4,
+        timeout_delta_s: float = 0.2,
+        block_gap_s: float = 0.0,
+        client_timeout_s: float = 5.0,
+        reannounce_s: float = 2.0,
+    ):
+        self.node = node
+        self.peer_addrs = list(peer_addrs)
+        self.tick_s = tick_s
+        self.base_timeout_s = base_timeout_s
+        self.timeout_delta_s = timeout_delta_s
+        self.block_gap_s = block_gap_s
+        self.client_timeout_s = client_timeout_s
+        self.reannounce_s = reannounce_s
+        self._links: Dict[str, _PeerLink] = {}
+        self._pull_clients: Dict[str, object] = {}
+        self._seen = _SeenSet()
+        self._seen_tx = _SeenSet()
+        self._announced = _SeenSet()
+        # timers: (due, step, height, round); key-dedup in _timer_keys
+        self._timers: List[Tuple[float, str, int, int]] = []
+        self._timer_keys: set = set()
+        self._behind_hint = 0  # highest height seen in foreign traffic
+        self._last_start = 0.0
+        self._last_reannounce = 0.0
+        self._last_status_poll = 0.0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        node.gossip_engine = self
+
+    # -- peer links ------------------------------------------------------
+
+    def _link(self, addr: str) -> _PeerLink:
+        link = self._links.get(addr)
+        if link is None:
+            link = _PeerLink(self, addr)
+            self._links[addr] = link
+        return link
+
+    def _flood(self, wire: dict, exclude: Optional[str] = None) -> None:
+        payload = {"wire": wire, "sender": self._self_name()}
+        for addr in self.peer_addrs:
+            if exclude is not None and addr == exclude:
+                continue
+            self._link(addr).send("msg", payload)
+
+    # -- inbound RPC surface (called from server threads) ---------------
+
+    def _wire_ok(self, wire: dict) -> bool:
+        """Structural + signature validation BEFORE propagation: the
+        sender is untrusted, so only messages signed by a known validator
+        are delivered, re-flooded, or allowed into the dedup set — junk
+        must neither amplify across the mesh nor evict legitimate dedup
+        entries."""
+        from celestia_tpu.node.bft import (
+            Proposal,
+            msg_from_wire,
+            proposal_sign_bytes,
+            vote_sign_bytes,
+        )
+        from celestia_tpu.utils.secp256k1 import PublicKey
+
+        eng = self.node._bft
+        if eng is None:
+            return False
+        try:
+            msg = msg_from_wire(wire)
+            if isinstance(msg, Proposal):
+                pk = eng.pubkeys.get(msg.proposer)
+                if pk is None:
+                    return False
+                digest = proposal_sign_bytes(
+                    eng.chain_id, msg.height, msg.round, msg.pol_round,
+                    msg.payload.block_id,
+                )
+                return PublicKey.from_compressed(pk).verify(
+                    digest, msg.signature
+                )
+            pk = eng.pubkeys.get(msg.validator)
+            if pk is None:
+                return False
+            digest = vote_sign_bytes(
+                eng.chain_id, msg.height, msg.round, msg.vtype, msg.block_id
+            )
+            return PublicKey.from_compressed(pk).verify(digest, msg.signature)
+        except Exception:
+            return False
+
+    def on_gossip(self, wire: dict, sender: str) -> bool:
+        """Deliver a flooded consensus message once; queue the re-flood.
+        The dedup id is computed HERE from the wire bytes — a sender-
+        supplied id could poison the dedup set (censorship) — and only
+        validator-signed messages propagate.  Returns True if the
+        message was new and valid."""
+        msg_id = wire_id(wire)
+        if msg_id in self._seen:
+            return False
+        if not self._wire_ok(wire):
+            return False  # unsigned junk: not delivered, not flooded
+        if not self._seen.add(msg_id):
+            return False
+        with self._lock:
+            h = int(wire.get("height", 0) or 0)
+            if h > self._behind_hint:
+                # a hint, not a fact: _catch_up verifies against peers'
+                # actual heights (a Byzantine validator can sign a vote
+                # at any height it likes)
+                self._behind_hint = h
+        try:
+            self.node.bft_msg(wire)
+        except Exception:
+            pass  # engine rejects bad messages; a raise must not kill RPC
+        self._flood(wire, exclude=sender)
+        return True
+
+    def on_tx_have(self, hashes: List[bytes]) -> List[bytes]:
+        """want/have: return the subset of announced tx hashes this node
+        does not hold."""
+        want = []
+        pool = self.node.mempool
+        for h in hashes:
+            if h in pool._txs or h in self._seen_tx:
+                continue
+            if self.node.get_tx(h) is not None:
+                continue  # already committed
+            want.append(h)
+        return want
+
+    def on_tx_push(self, raws: List[bytes]) -> int:
+        """Admit pushed txs through CheckTx; re-announce admitted ones.
+        A failed admission is NOT marked seen: it may succeed later
+        (sequence gaps), and the periodic re-announce retries it."""
+        admitted = 0
+        for raw in raws:
+            h = hashlib.sha256(raw).digest()
+            if h in self._seen_tx:
+                continue
+            try:
+                res = self.node.broadcast_tx(raw)
+            except Exception:
+                continue
+            if res.code == 0:
+                self._seen_tx.add(h)
+                admitted += 1
+        return admitted
+
+    # -- the pump loop ---------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="gossip-pump", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        for link in self._links.values():
+            link.stop()
+        self._links.clear()
+        for addr in list(self._pull_clients):
+            self._drop_pull_client(addr)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._pump_once()
+            except Exception:
+                pass  # the mesh must survive transient RPC storms
+            _time.sleep(self.tick_s)
+
+    def _pump_once(self) -> None:
+        now = _time.time()
+        # 1. fire due timers FIRST — liveness must not wait on any RPC
+        with self._lock:
+            due_now = [t for t in self._timers if t[0] <= now]
+            self._timers = [t for t in self._timers if t[0] > now]
+            for _, s, h, r in due_now:
+                self._timer_keys.discard((s, h, r))
+        for _, step, height, round_ in due_now:
+            try:
+                self.node.bft_timeout(step, height, round_)
+            except Exception:
+                pass
+        # 2. start the next height when the current one is decided
+        if self.node._bft is not None and (
+            now - self._last_start >= self.block_gap_s
+        ):
+            target = self.node.height + 1
+            if self.node._bft.height < target:
+                try:
+                    self.node.bft_start(target)
+                    self._last_start = now
+                except Exception:
+                    pass
+        # 3. drain own outbox + timeout requests; enqueue floods
+        d = self.node.bft_drain()
+        for wire in d["outbox"]:
+            self._seen.add(wire_id(wire))  # don't re-deliver our own
+            self._flood(wire)
+        with self._lock:
+            for t in d["timeouts"]:
+                key = (t["step"], t["height"], t["round"])
+                if key not in self._timer_keys:
+                    self._timer_keys.add(key)
+                    due = now + self.base_timeout_s + (
+                        self.timeout_delta_s * t["round"]
+                    )
+                    self._timers.append((due, *key))
+        # 4. announce pooled txs (fresh every tick; full pool periodically)
+        self._announce_txs(now)
+        # 5. catch-up pull when traffic shows we're behind
+        self._catch_up()
+
+    def _self_name(self) -> str:
+        return getattr(self.node, "_server_address", "") or "peer"
+
+    def _announce_txs(self, now: float) -> None:
+        pool = self.node.mempool
+        full = now - self._last_reannounce >= self.reannounce_s
+        if full:
+            self._last_reannounce = now
+        # snapshot under the node lock: gRPC workers mutate the pool
+        # concurrently (CheckTx admissions, commit-time removals)
+        with self.node._service_lock:
+            items = [(h, t.raw) for h, t in pool._txs.items()]
+        batch = []
+        for h, raw in items:
+            if self._announced.add(h) or full:
+                batch.append((h, raw))
+        if not batch:
+            return
+        hashes = [h for h, _ in batch]
+        by_hash = dict(batch)
+        for addr in self.peer_addrs:
+            self._link(addr).send("announce", (hashes, by_hash))
+
+    def _pull_client(self, addr: str):
+        cli = self._pull_clients.get(addr)
+        if cli is None:
+            from celestia_tpu.client.remote import RemoteNode
+
+            try:
+                cli = RemoteNode(addr, timeout_s=self.client_timeout_s)
+            except Exception:
+                return None
+            self._pull_clients[addr] = cli
+        return cli
+
+    def _drop_pull_client(self, addr: str) -> None:
+        cli = self._pull_clients.pop(addr, None)
+        if cli is not None:
+            try:
+                cli.close()
+            except Exception:
+                pass
+
+    def _catch_up(self) -> None:
+        """Pull decided blocks we're missing.  Runs in the pump thread
+        with direct (blocking) RPCs — only active when behind, and the
+        timers already fired this tick.
+
+        The wire-derived hint only TRIGGERS the check; the pull target
+        is the peers' actually-reported best height (rate-limited status
+        poll), so a Byzantine validator signing sky-high vote heights
+        cannot lock the mesh into a permanent catch-up loop — a hint no
+        reachable peer corroborates is discarded."""
+        now = _time.time()
+        with self._lock:
+            behind = self._behind_hint
+        if self.node.height + 1 >= behind:
+            return
+        if now - self._last_status_poll < 0.5:
+            return
+        self._last_status_poll = now
+        best = 0
+        for addr in self.peer_addrs:
+            cli = self._pull_client(addr)
+            if cli is None:
+                continue
+            try:
+                best = max(best, int(cli.status().get("height", 0)))
+            except Exception:
+                self._drop_pull_client(addr)
+        if best <= self.node.height:
+            with self._lock:
+                # nobody is actually ahead: the hint was noise
+                self._behind_hint = self.node.height
+            return
+        target = best
+        for addr in self.peer_addrs:
+            if self.node.height >= target:
+                return
+            cli = self._pull_client(addr)
+            if cli is None:
+                continue
+            try:
+                while self.node.height < target:
+                    d = cli.bft_decided(self.node.height + 1)
+                    if d is None:
+                        break
+                    if not self.node.bft_catchup(d)[0]:
+                        break
+            except Exception:
+                self._drop_pull_client(addr)

@@ -197,6 +197,44 @@ class NodeService:
         except Exception as e:
             return json.dumps({"code": 1, "log": str(e)}).encode()
 
+    # -- p2p gossip mesh (node/gossip.py) -------------------------------
+
+    def gossip_msg(self, req: bytes, ctx) -> bytes:
+        d = json.loads(req)
+        eng = getattr(self.node, "gossip_engine", None)
+        if eng is None:
+            # no mesh engine on this node: deliver directly (lets a
+            # meshed peer talk to a relay-driven node during rollout)
+            self.node.bft_msg(d["wire"])
+            return json.dumps({"new": True}).encode()
+        # dedup id is computed engine-side from the wire content; a
+        # sender-supplied id is never trusted
+        new = eng.on_gossip(d["wire"], d.get("sender", ""))
+        return json.dumps({"new": new}).encode()
+
+    def tx_have(self, req: bytes, ctx) -> bytes:
+        d = json.loads(req)
+        eng = getattr(self.node, "gossip_engine", None)
+        hashes = [bytes.fromhex(h) for h in d.get("hashes", [])]
+        want = eng.on_tx_have(hashes) if eng is not None else []
+        return json.dumps({"want": [h.hex() for h in want]}).encode()
+
+    def tx_push(self, req: bytes, ctx) -> bytes:
+        d = json.loads(req)
+        eng = getattr(self.node, "gossip_engine", None)
+        raws = [bytes.fromhex(r) for r in d.get("txs", [])]
+        if eng is not None:
+            n = eng.on_tx_push(raws)
+        else:
+            n = 0
+            for raw in raws:
+                try:
+                    if self.node.broadcast_tx(raw).code == 0:
+                        n += 1
+                except Exception:
+                    continue
+        return json.dumps({"admitted": n}).encode()
+
     # -- grpc wiring ---------------------------------------------------
 
     def handlers(self) -> grpc.GenericRpcHandler:
@@ -217,6 +255,9 @@ class NodeService:
             "BftDrain": self.bft_drain,
             "BftDecided": self.bft_decided,
             "BftCatchup": self.bft_catchup,
+            "GossipMsg": self.gossip_msg,
+            "TxHave": self.tx_have,
+            "TxPush": self.tx_push,
         }
         method_handlers = {
             name: grpc.unary_unary_rpc_method_handler(
@@ -248,6 +289,9 @@ class NodeServer:
             raise RuntimeError(f"could not bind gRPC server to {address}")
         host = address.rsplit(":", 1)[0]
         self.address = f"{host}:{self.port}"
+        # the gossip engine stamps outbound floods with this (sender
+        # exclusion on re-flood)
+        node._server_address = self.address
         self.block_interval_s = block_interval_s
         self._stop = threading.Event()
         self._producer: Optional[threading.Thread] = None

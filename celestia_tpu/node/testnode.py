@@ -174,6 +174,7 @@ class TestNode:
             b"testnode-validator"
         )
         self._bft = None  # armed by enable_bft()
+        self._bft_decided_log: Dict[int, dict] = {}
         if recovered_blocks:
             # disk recovery: resume the chain where the logs end
             self.blocks = recovered_blocks
@@ -246,6 +247,7 @@ class TestNode:
                 "BFT valset — check priv_validator_key.json vs valset.json"
             )
         self._bft_block_ids: Dict[int, bytes] = {}
+        self._bft_decided_log: Dict[int, dict] = {}
         self._bft = BFTNode(
             chain_id=self.chain_id,
             key=self._validator_key,
@@ -326,6 +328,16 @@ class TestNode:
         self._bft_block_ids[payload.height] = payload.block_id
         for h in [h for h in self._bft_block_ids if h < payload.height - 16]:
             del self._bft_block_ids[h]
+        # bounded decided log for laggard catch-up past the engine's
+        # prune window (the payload wire carries the full tx list, so
+        # the window trades memory for how far behind a peer may fall
+        # before needing a snapshot)
+        self._bft_decided_log[payload.height] = {
+            "payload": payload.to_wire(),
+            "precommits": [v.to_wire() for v in decided.precommits],
+        }
+        while len(self._bft_decided_log) > 512:
+            self._bft_decided_log.pop(next(iter(self._bft_decided_log)))
         # identical LastCommitInfo everywhere: derived from the payload's
         # certificate over the SORTED valset, never from local votes
         vote_pairs = last_commit_vote_pairs(self._bft.validators, payload)
@@ -363,17 +375,21 @@ class TestNode:
     def bft_decided(self, height: int) -> Optional[dict]:
         """Serve a decided block + its precommit certificate for laggard
         catch-up.  The certificate is what makes the replay trustless:
-        the receiver verifies the 2/3 signatures, not the sender."""
+        the receiver verifies the 2/3 signatures, not the sender.
+        Backed by the engine's recent window first, then the node's
+        bounded decided log (the engine prunes aggressively; a laggard
+        more than a few heights behind still needs the certificates —
+        beyond the log window, snapshot state-sync takes over)."""
         with self._service_lock:
             if self._bft is None:
                 return None
             d = self._bft.decided.get(height)
-            if d is None:
-                return None
-            return {
-                "payload": d.payload.to_wire(),
-                "precommits": [v.to_wire() for v in d.precommits],
-            }
+            if d is not None:
+                return {
+                    "payload": d.payload.to_wire(),
+                    "precommits": [v.to_wire() for v in d.precommits],
+                }
+            return self._bft_decided_log.get(height)
 
     def bft_catchup(self, decided_wire: dict) -> Tuple[bool, str]:
         """Adopt an externally-replayed decided block after verifying

@@ -15,6 +15,7 @@ arithmetic or the bit-exact device kernels.
 
 from __future__ import annotations
 
+import hashlib as _hashlib
 import time as _time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -302,8 +303,6 @@ class App:
                     # Prepare/Process reuse it instead of re-hashing the
                     # blob payloads (check_tx.go validates, then the
                     # proposal paths validate the same bytes again)
-                    import hashlib as _hashlib
-
                     self._remember_decoded(
                         _hashlib.sha256(raw).digest(), tx, btx.tx
                     )
@@ -360,8 +359,6 @@ class App:
         proves the same signature check.  (CheckTx verifies inline in
         the ante chain and does not populate this cache.)
         """
-        import hashlib as _hashlib
-
         from celestia_tpu.utils.secp256k1 import verify_batch
 
         # ONE full-data hash per tx, shared by the decoded-tx cache and

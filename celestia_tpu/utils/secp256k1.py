@@ -355,7 +355,6 @@ def verify_batch(msgs, sigs, pubkeys) -> list:
         return out
 
     results = [False] * n
-    pubs = np.zeros((n, 33), dtype=np.uint8)
     rs = [0] * n
     live = np.zeros(n, dtype=bool)
     # Montgomery batch inversion: ONE modular inversion for the whole
@@ -383,6 +382,7 @@ def verify_batch(msgs, sigs, pubkeys) -> list:
         ks = np.zeros((n, 128), dtype=np.uint8)
         sgn = np.zeros((n, 4), dtype=np.uint8)
     else:
+        pubs = np.zeros((n, 33), dtype=np.uint8)
         u1s = np.zeros((n, 32), dtype=np.uint8)
         u2s = np.zeros((n, 32), dtype=np.uint8)
     for i in range(n):

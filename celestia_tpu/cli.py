@@ -503,6 +503,44 @@ def cmd_query(args) -> int:
             "shares": sum(len(r.shares) for r in result.rows),
             "payload_hex": result.blobs_payload().hex() if verified else "",
         }))
+    elif args.query_cmd == "blobstream":
+        if args.bs_cmd == "attestation":
+            print(json.dumps(node.abci_query(
+                "custom/blobstream/attestation", {"nonce": args.nonce}
+            )))
+        elif args.bs_cmd == "nonce":
+            print(json.dumps(node.abci_query(
+                "custom/blobstream/latest_nonce", {}
+            )))
+        elif args.bs_cmd == "range":
+            print(json.dumps(node.abci_query(
+                "custom/blobstream/data_commitment_range",
+                {"height": args.height},
+            )))
+        elif args.bs_cmd == "verify":
+            # client/verify.go VerifyShares parity: prove the shares are
+            # covered by a DataCommitment, verifying every link locally
+            from celestia_tpu.client.blobstream import (
+                BlobstreamVerifyError,
+                verify_shares,
+            )
+
+            try:
+                v = verify_shares(
+                    node, int(args.height), int(args.start), int(args.end)
+                )
+            except BlobstreamVerifyError as e:
+                print(json.dumps({"verified": False, "reason": str(e)}))
+                return 1
+            print(json.dumps({
+                "verified": True,
+                "height": v.height,
+                "data_root": v.data_root.hex(),
+                "nonce": v.nonce,
+                "begin_block": v.begin_block,
+                "end_block": v.end_block,
+                "tuple_root": v.tuple_root.hex(),
+            }))
     elif args.query_cmd == "das-sample":
         # fetch + VERIFY n random samples like a light client would
         from celestia_tpu.da import das as das_mod
@@ -861,6 +899,23 @@ def build_parser() -> argparse.ArgumentParser:
     )
     q.add_argument("height", type=int)
     q.add_argument("namespace", help="29-byte namespace, hex")
+    q = qs.add_parser(
+        "blobstream", help="EVM-bridge attestations + client verification"
+    )
+    bs = q.add_subparsers(dest="bs_cmd", required=True)
+    b = bs.add_parser("attestation")
+    b.add_argument("nonce", type=int)
+    bs.add_parser("nonce")
+    b = bs.add_parser("range", help="DataCommitment window covering a height")
+    b.add_argument("height", type=int)
+    b = bs.add_parser(
+        "verify",
+        help="prove shares are covered by a DataCommitment "
+             "(client/verify.go VerifyShares parity)",
+    )
+    b.add_argument("height", type=int)
+    b.add_argument("start", type=int)
+    b.add_argument("end", type=int)
     sp.set_defaults(fn=cmd_query)
 
     sp = sub.add_parser("status", help="node status")

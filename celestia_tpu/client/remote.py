@@ -178,7 +178,9 @@ class RemoteNode:
     # -- p2p gossip mesh surface (node/gossip.py) -----------------------
 
     def gossip_msg(self, payload: dict) -> bool:
-        """Deliver a flooded consensus message: {"id", "wire", "sender"}."""
+        """Deliver a flooded consensus message: {"wire", "sender"}.  The
+        dedup id is always computed receiver-side from the wire content —
+        a sender-supplied id would be a censorship vector."""
         return bool(self._call_json("GossipMsg", payload).get("new"))
 
     def tx_have(self, hashes) -> list:

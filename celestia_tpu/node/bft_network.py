@@ -71,6 +71,7 @@ class BFTNetwork:
         funded_accounts=None,
         powers: Optional[List[int]] = None,
         block_interval_ns: int = GOAL_BLOCK_TIME_SECONDS * 10**9,
+        v2_upgrade_height: Optional[int] = None,
     ):
         self.chain_id = chain_id
         self.block_interval_ns = block_interval_ns
@@ -114,7 +115,9 @@ class BFTNetwork:
             for k in keys
         }
         for i, (key, power) in enumerate(zip(keys, powers)):
-            app = App(chain_id=chain_id)
+            app = App(
+                chain_id=chain_id, v2_upgrade_height=v2_upgrade_height
+            )
             app.init_chain(genesis)
             val = BFTValidator(f"val-{i}", key, power, app)
             val.engine = BFTNode(

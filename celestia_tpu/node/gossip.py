@@ -198,11 +198,14 @@ class GossipEngine:
     # -- peer links ------------------------------------------------------
 
     def _link(self, addr: str) -> _PeerLink:
-        link = self._links.get(addr)
-        if link is None:
-            link = _PeerLink(self, addr)
-            self._links[addr] = link
-        return link
+        # pump + gRPC server threads both create links; the lock keeps a
+        # racing double-create from orphaning a worker thread
+        with self._lock:
+            link = self._links.get(addr)
+            if link is None:
+                link = _PeerLink(self, addr)
+                self._links[addr] = link
+            return link
 
     def _flood(self, wire: dict, exclude: Optional[str] = None) -> None:
         payload = {"wire": wire, "sender": self._self_name()}

@@ -835,6 +835,20 @@ class TestNode:
                 "next_version_power": tally[0],
                 "total_power": tally[1],
             }
+        if path == "custom/blobstream/attestation":
+            att = self.app.blobstream.attestation(int(data["nonce"]))
+            return {"found": att is not None, "attestation": att}
+        if path == "custom/blobstream/latest_nonce":
+            return {"nonce": self.app.blobstream.latest_nonce()}
+        if path == "custom/blobstream/data_commitment_range":
+            att = self.app.blobstream.data_commitment_for_height(
+                int(data["height"])
+            )
+            return {"found": att is not None, "data_commitment": att}
+        if path == "custom/blobstream/data_root_inclusion":
+            return self.app.blobstream.data_root_inclusion_proof(
+                int(data["height"]), int(data["begin"]), int(data["end"])
+            )
         if path == "custom/distribution/rewards":
             delegator = bytes.fromhex(data["delegator"])
             validator = bytes.fromhex(data["validator"])

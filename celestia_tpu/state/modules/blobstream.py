@@ -225,6 +225,62 @@ class BlobstreamKeeper:
             tuples.append((h, root))
         return data_root_tuple_root(tuples)
 
+    # --- query/verify surface (x/blobstream query server + client/verify.go)
+
+    def data_commitment_for_height(self, height: int) -> Optional[dict]:
+        """DataCommitmentRangeForHeight parity
+        (keeper/query_data_commitment.go): the DataCommitment attestation
+        whose [begin_block, end_block) window covers ``height``."""
+        for att in self.attestations():
+            if att.get("type") != DataCommitment.TYPE:
+                continue
+            if att["begin_block"] <= height < att["end_block"]:
+                return att
+        return None
+
+    def data_root_inclusion_proof(
+        self, height: int, begin: int, end: int
+    ) -> dict:
+        """Merkle proof that block ``height``'s (height, data_root) tuple
+        is a leaf of the [begin, end) window's data-root tuple root — the
+        proof an EVM relayer posts against the Blobstream contract
+        (client/verify.go DataRootInclusionProof role).  Serialized
+        JSON-safe; verify with client/blobstream.verify_data_root_inclusion."""
+        from celestia_tpu.da.proof import merkle_proof
+
+        if not (begin <= height < end):
+            raise ValueError(
+                f"height {height} outside the window [{begin}, {end})"
+            )
+        # only ATTESTED windows are provable: this is reachable from an
+        # unauthenticated query route, and an arbitrary [begin, end)
+        # would let a remote caller size the loop below at will
+        att = self.data_commitment_for_height(height)
+        if att is None or att["begin_block"] != begin or (
+            att["end_block"] != end
+        ):
+            raise ValueError(
+                f"[{begin}, {end}) is not an attested DataCommitment window"
+            )
+        leaves = []
+        target_root: Optional[bytes] = None
+        for h in range(begin, end):
+            root = self.data_root(h) or b"\x00" * 32
+            if h == height:
+                target_root = root
+            leaves.append(h.to_bytes(8, "big") + root)
+        proof = merkle_proof(leaves, height - begin)
+        return {
+            "height": height,
+            "begin_block": begin,
+            "end_block": end,
+            "data_root": target_root.hex(),
+            "index": proof.index,
+            "total": proof.total,
+            "aunts": [a.hex() for a in proof.aunts],
+            "tuple_root": rfc6962_root_np(leaves).tobytes().hex(),
+        }
+
     def _prune(self, now_ns: int) -> None:
         for _, raw in list(self.store.iterate(_ATTESTATION_PREFIX)):
             att = json.loads(raw)

@@ -1,0 +1,276 @@
+// Standalone cross-language decoder for the external wire contract
+// (specs/wire.md).  Deliberately NOT linked against anything in this
+// repo and free of third-party libraries: if this program can decode a
+// node's bytes with only the spec and the C++ standard library, so can
+// any other language.
+//
+// Usage:  wire_decoder <mode>   (tx | blobtx | dah | account)
+// Input:  one hex string on stdin (for `account`: the raw JSON).
+// Output: one JSON object on stdout; exit 1 on malformed input.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+static std::vector<uint8_t> from_hex(const std::string& s) {
+    if (s.size() % 2) throw std::runtime_error("odd hex length");
+    std::vector<uint8_t> out(s.size() / 2);
+    for (size_t i = 0; i < out.size(); i++) {
+        unsigned v;
+        if (sscanf(s.c_str() + 2 * i, "%2x", &v) != 1)
+            throw std::runtime_error("bad hex");
+        out[i] = (uint8_t)v;
+    }
+    return out;
+}
+
+static std::string json_escape(const uint8_t* p, size_t n) {
+    // memo bytes are attacker-chosen; quotes/backslashes/control chars
+    // must not corrupt the decoder's own JSON output
+    std::string out;
+    out.reserve(n);
+    for (size_t i = 0; i < n; i++) {
+        uint8_t c = p[i];
+        if (c == '"' || c == '\\') {
+            out += '\\';
+            out += (char)c;
+        } else if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+        } else {
+            out += (char)c;
+        }
+    }
+    return out;
+}
+
+static std::string to_hex(const uint8_t* p, size_t n) {
+    static const char* d = "0123456789abcdef";
+    std::string out;
+    out.reserve(2 * n);
+    for (size_t i = 0; i < n; i++) {
+        out += d[p[i] >> 4];
+        out += d[p[i] & 15];
+    }
+    return out;
+}
+
+struct Reader {
+    const uint8_t* p;
+    size_t n, pos = 0;
+    Reader(const std::vector<uint8_t>& v) : p(v.data()), n(v.size()) {}
+    Reader(const uint8_t* data, size_t len) : p(data), n(len) {}
+
+    // unsigned LEB128, bounded to uint64 (spec "Primitives")
+    uint64_t varint() {
+        uint64_t out = 0;
+        int shift = 0;
+        while (true) {
+            if (pos >= n) throw std::runtime_error("truncated varint");
+            uint8_t b = p[pos++];
+            out |= (uint64_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) return out;
+            shift += 7;
+            if (shift > 63) throw std::runtime_error("varint too long");
+        }
+    }
+
+    std::pair<const uint8_t*, size_t> bytes() {
+        uint64_t len = varint();
+        // overflow-safe form: pos + len can wrap for hostile 64-bit lens
+        if (len > n - pos) throw std::runtime_error("truncated bytes");
+        const uint8_t* out = p + pos;
+        pos += len;
+        return {out, (size_t)len};
+    }
+
+    uint32_t u32_be() {
+        if (pos + 4 > n) throw std::runtime_error("truncated u32");
+        uint32_t v = ((uint32_t)p[pos] << 24) | ((uint32_t)p[pos + 1] << 16) |
+                     ((uint32_t)p[pos + 2] << 8) | p[pos + 3];
+        pos += 4;
+        return v;
+    }
+
+    void expect_done(const char* what) {
+        if (pos != n)
+            throw std::runtime_error(std::string("trailing bytes in ") + what);
+    }
+};
+
+// one msg body per the TYPE registry (specs/wire.md table)
+static std::string decode_msg(const uint8_t* data, size_t len) {
+    Reader r(data, len);
+    uint64_t type = r.varint();
+    std::ostringstream out;
+    out << "{\"type\":" << type;
+    if (type == 1) {  // MsgSend
+        auto from = r.bytes();
+        auto to = r.bytes();
+        uint64_t amount = r.varint();
+        out << ",\"from\":\"" << to_hex(from.first, from.second)
+            << "\",\"to\":\"" << to_hex(to.first, to.second)
+            << "\",\"amount\":" << amount;
+    } else if (type == 2) {  // MsgPayForBlobs
+        auto signer = r.bytes();
+        uint64_t count = r.varint();
+        out << ",\"signer\":\"" << to_hex(signer.first, signer.second)
+            << "\",\"blobs\":[";
+        for (uint64_t i = 0; i < count; i++) {
+            auto ns = r.bytes();
+            uint64_t size = r.varint();
+            auto comm = r.bytes();
+            uint64_t ver = r.varint();
+            out << (i ? "," : "") << "{\"namespace\":\""
+                << to_hex(ns.first, ns.second) << "\",\"blob_size\":" << size
+                << ",\"commitment\":\"" << to_hex(comm.first, comm.second)
+                << "\",\"share_version\":" << ver << "}";
+        }
+        out << "]";
+    } else {
+        // other msg types: expose the raw body so the caller still sees
+        // a well-formed envelope (registry lives in state/tx.py)
+        out << ",\"raw\":\"" << to_hex(data + r.pos, len - r.pos) << "\"";
+        r.pos = len;
+    }
+    r.expect_done("msg");
+    out << "}";
+    return out.str();
+}
+
+static std::string decode_tx(const std::vector<uint8_t>& raw) {
+    Reader r(raw);
+    auto body = r.bytes();
+    auto auth = r.bytes();
+    auto sig = r.bytes();
+    r.expect_done("tx");
+
+    Reader br(body.first, body.second);
+    uint64_t n_msgs = br.varint();
+    std::ostringstream out;
+    out << "{\"msgs\":[";
+    for (uint64_t i = 0; i < n_msgs; i++) {
+        auto m = br.bytes();
+        out << (i ? "," : "") << decode_msg(m.first, m.second);
+    }
+    auto memo = br.bytes();
+    uint64_t timeout_height = br.varint();
+    br.expect_done("tx body");
+
+    Reader ar(auth.first, auth.second);
+    uint64_t fee_amount = ar.varint();
+    uint64_t gas_limit = ar.varint();
+    auto pubkey = ar.bytes();
+    uint64_t sequence = ar.varint();
+    uint64_t account_number = ar.varint();
+    auto granter = ar.bytes();
+    ar.expect_done("tx auth");
+
+    out << "],\"memo\":\"" << json_escape(memo.first, memo.second)
+        << "\",\"timeout_height\":" << timeout_height
+        << ",\"fee_amount\":" << fee_amount << ",\"gas_limit\":" << gas_limit
+        << ",\"pubkey\":\"" << to_hex(pubkey.first, pubkey.second)
+        << "\",\"sequence\":" << sequence
+        << ",\"account_number\":" << account_number << ",\"fee_granter\":\""
+        << to_hex(granter.first, granter.second) << "\",\"signature\":\""
+        << to_hex(sig.first, sig.second) << "\"}";
+    return out.str();
+}
+
+static std::string decode_blobtx(const std::vector<uint8_t>& raw) {
+    static const char MAGIC[8] = {'C', 'T', 'P', 'U', 'B', 'L', 'B', '0'};
+    if (raw.size() < 8 || memcmp(raw.data(), MAGIC, 8) != 0)
+        throw std::runtime_error("missing BlobTx magic");
+    Reader r(raw.data() + 8, raw.size() - 8);
+    auto tx = r.bytes();
+    uint64_t n_blobs = r.varint();
+    std::ostringstream out;
+    out << "{\"tx_bytes\":" << tx.second << ",\"blobs\":[";
+    for (uint64_t i = 0; i < n_blobs; i++) {
+        if (r.pos + 29 > r.n) throw std::runtime_error("truncated namespace");
+        std::string ns = to_hex(r.p + r.pos, 29);  // fixed width, no prefix
+        r.pos += 29;
+        uint64_t ver = r.varint();
+        auto data = r.bytes();
+        out << (i ? "," : "") << "{\"namespace\":\"" << ns
+            << "\",\"data_len\":" << data.second
+            << ",\"share_version\":" << ver << "}";
+    }
+    r.expect_done("blobtx");
+    out << "]}";
+    return out.str();
+}
+
+static std::string decode_dah(const std::vector<uint8_t>& raw) {
+    Reader r(raw);
+    uint32_t n_rows = r.u32_be();
+    std::ostringstream out;
+    out << "{\"row_roots\":[";
+    for (uint32_t i = 0; i < n_rows; i++) {
+        if (r.pos + 90 > r.n) throw std::runtime_error("truncated root");
+        out << (i ? "," : "") << "\"" << to_hex(r.p + r.pos, 90) << "\"";
+        r.pos += 90;
+    }
+    uint32_t n_cols = r.u32_be();
+    out << "],\"col_roots\":[";
+    for (uint32_t i = 0; i < n_cols; i++) {
+        if (r.pos + 90 > r.n) throw std::runtime_error("truncated root");
+        out << (i ? "," : "") << "\"" << to_hex(r.p + r.pos, 90) << "\"";
+        r.pos += 90;
+    }
+    r.expect_done("dah");
+    out << "]}";
+    return out.str();
+}
+
+// AccountInfo JSON response: {"account_number": N, "sequence": N}.
+// A 20-line scan is all the "client library" this contract requires.
+static std::string decode_account(const std::string& json) {
+    long long acct = -1, seq = -1;
+    const char* p = strstr(json.c_str(), "\"account_number\"");
+    if (p && sscanf(p, "\"account_number\"%*[: ]%lld", &acct) != 1) acct = -1;
+    p = strstr(json.c_str(), "\"sequence\"");
+    if (p && sscanf(p, "\"sequence\"%*[: ]%lld", &seq) != 1) seq = -1;
+    if (acct < 0 || seq < 0)
+        throw std::runtime_error("account response missing fields");
+    std::ostringstream out;
+    out << "{\"account_number\":" << acct << ",\"sequence\":" << seq << "}";
+    return out.str();
+}
+
+int main(int argc, char** argv) {
+    if (argc != 2) {
+        fprintf(stderr, "usage: wire_decoder <tx|blobtx|dah|account>\n");
+        return 2;
+    }
+    std::string input, line;
+    while (std::getline(std::cin, line)) input += line;
+    try {
+        std::string mode = argv[1];
+        if (mode == "account") {
+            std::cout << decode_account(input) << "\n";
+            return 0;
+        }
+        auto raw = from_hex(input);
+        if (mode == "tx")
+            std::cout << decode_tx(raw) << "\n";
+        else if (mode == "blobtx")
+            std::cout << decode_blobtx(raw) << "\n";
+        else if (mode == "dah")
+            std::cout << decode_dah(raw) << "\n";
+        else {
+            fprintf(stderr, "unknown mode %s\n", mode.c_str());
+            return 2;
+        }
+    } catch (const std::exception& e) {
+        fprintf(stderr, "decode error: %s\n", e.what());
+        return 1;
+    }
+    return 0;
+}

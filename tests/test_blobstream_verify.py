@@ -1,0 +1,171 @@
+"""Blobstream query + client verification (VERDICT r3 #5).
+
+Parity: /root/reference/x/blobstream/client/verify.go:197 (VerifyShares)
+and :323 (VerifyDataRootInclusion), keeper/query_data_commitment.go.
+A client proves a committed blob against a DataCommitment fetched over
+gRPC, walking share -> data root -> tuple root with every link verified
+locally; tampering any link fails the verification.
+"""
+
+import numpy as np
+import pytest
+
+from celestia_tpu.client.blobstream import (
+    BlobstreamVerifyError,
+    verify_data_root_inclusion,
+    verify_shares,
+)
+from celestia_tpu.client.remote import RemoteNode
+from celestia_tpu.client.signer import Signer
+from celestia_tpu.da.blob import Blob
+from celestia_tpu.da.namespace import Namespace
+from celestia_tpu.node.server import NodeServer
+from celestia_tpu.node.testnode import TestNode
+from celestia_tpu.utils.secp256k1 import PrivateKey
+
+WINDOW = 4
+
+
+@pytest.fixture(scope="module")
+def net():
+    from celestia_tpu.da import dah as dah_mod
+
+    for k in (1, 2, 4):
+        dah_mod.extend_and_header(np.zeros((k, k, 512), dtype=np.uint8))
+    alice = PrivateKey.from_seed(b"bsverify-alice")
+    node = TestNode(funded_accounts=[(alice, 10**12)], auto_produce=False)
+    node.app.params.set("blobstream", "DataCommitmentWindow", WINDOW)
+    server = NodeServer(node, block_interval_s=0.1)
+    server.start()
+    remote = RemoteNode(server.address, timeout_s=120.0)
+    signer = Signer(remote, alice)
+    # a blob early in the window, then enough blocks to close it
+    blob = Blob(Namespace.v0(b"\x0b" * 10), b"blobstream payload " * 50)
+    res = signer.submit_pay_for_blob([blob])
+    assert res.code == 0, res.log
+    remote.wait_for_height(
+        (res.height // WINDOW + 1) * WINDOW, timeout_s=120.0
+    )
+    yield node, remote, res.height
+    server.stop()
+    remote.close()
+
+
+def test_attestation_queries(net):
+    node, remote, blob_height = net
+    nonce = remote.abci_query("custom/blobstream/latest_nonce", {})["nonce"]
+    assert nonce >= 1
+    att = remote.abci_query(
+        "custom/blobstream/attestation", {"nonce": nonce}
+    )
+    assert att["found"]
+    rng = remote.abci_query(
+        "custom/blobstream/data_commitment_range", {"height": blob_height}
+    )
+    assert rng["found"]
+    dc = rng["data_commitment"]
+    assert dc["begin_block"] <= blob_height < dc["end_block"]
+    assert dc["type"] == "data_commitment"
+
+
+def test_verify_shares_end_to_end(net):
+    """The full client walk over gRPC: share proof -> data root ->
+    DataCommitment tuple root, every link checked locally."""
+    node, remote, blob_height = net
+    v = verify_shares(remote, blob_height, 1, 2)
+    assert v.height == blob_height
+    assert v.begin_block <= blob_height < v.end_block
+    # the verified data root matches the block header's
+    assert v.data_root.hex() == remote.block(blob_height)["data_root"]
+    # and the tuple root matches the stored attestation byte-for-byte
+    att = remote.abci_query(
+        "custom/blobstream/data_commitment_range", {"height": blob_height}
+    )["data_commitment"]
+    assert v.tuple_root.hex() == att["data_root_tuple_root"]
+
+
+def test_verify_shares_against_in_process_node(net):
+    """Same walk against the in-process node object (abci_query duck
+    typing): the client verifier is transport-agnostic."""
+    node, _, blob_height = net
+    v = verify_shares(node, blob_height, 1, 2)
+    assert v.nonce >= 1
+
+
+def test_uncovered_height_fails(net):
+    node, remote, _ = net
+    # the current height's window has not closed yet
+    open_height = (node.height // WINDOW) * WINDOW + 1
+    if open_height <= node.height:
+        with pytest.raises(BlobstreamVerifyError, match="no DataCommitment"):
+            verify_shares(remote, node.height, 0, 1)
+
+
+def test_tampered_tuple_proof_fails(net):
+    node, remote, blob_height = net
+    att = remote.abci_query(
+        "custom/blobstream/data_commitment_range", {"height": blob_height}
+    )["data_commitment"]
+    dri = remote.abci_query(
+        "custom/blobstream/data_root_inclusion",
+        {
+            "height": blob_height,
+            "begin": att["begin_block"],
+            "end": att["end_block"],
+        },
+    )
+    data_root = bytes.fromhex(dri["data_root"])
+    tuple_root = bytes.fromhex(att["data_root_tuple_root"])
+    assert verify_data_root_inclusion(blob_height, data_root, dri, tuple_root)
+    # flip one aunt byte
+    bad = dict(dri)
+    aunts = list(dri["aunts"])
+    if aunts:
+        first = bytes.fromhex(aunts[0])
+        aunts[0] = (bytes([first[0] ^ 1]) + first[1:]).hex()
+    bad["aunts"] = aunts
+    assert not verify_data_root_inclusion(
+        blob_height, data_root, bad, tuple_root
+    )
+    # wrong data root
+    assert not verify_data_root_inclusion(
+        blob_height, b"\x13" * 32, dri, tuple_root
+    )
+    # wrong height claims a different leaf
+    assert not verify_data_root_inclusion(
+        blob_height + 1, data_root, dri, tuple_root
+    )
+    # tampered attestation root
+    assert not verify_data_root_inclusion(
+        blob_height, data_root, dri, b"\x22" * 32
+    )
+
+
+def test_tampering_node_response_is_caught(net):
+    """A lying node that serves a consistent-looking but different data
+    root for the tuple proof must fail the cross-check."""
+    node, remote, blob_height = net
+
+    class LyingNode:
+        def abci_query(self, path, data):
+            out = node.abci_query(path, data)
+            if path == "custom/blobstream/data_root_inclusion":
+                out = dict(out)
+                out["data_root"] = ("11" * 32)
+            return out
+
+    with pytest.raises(BlobstreamVerifyError, match="different data root"):
+        verify_shares(LyingNode(), blob_height, 1, 2)
+
+
+def test_window_boundaries_cover_every_height(net):
+    """Every height in a closed window resolves to exactly that window."""
+    node, remote, _ = net
+    closed_end = (node.height // WINDOW) * WINDOW
+    for h in range(1, closed_end + 1):
+        rng = node.abci_query(
+            "custom/blobstream/data_commitment_range", {"height": h}
+        )
+        assert rng["found"], f"height {h} uncovered"
+        dc = rng["data_commitment"]
+        assert dc["begin_block"] <= h < dc["end_block"]

@@ -39,6 +39,7 @@ from celestia_tpu.state.modules.blobstream import BlobstreamKeeper
 from celestia_tpu.state.modules.mint import MintKeeper
 from celestia_tpu.state.modules.upgrade import UpgradeKeeper
 from celestia_tpu.state.params import ParamBlockList, ParamsKeeper, set_default_params
+from celestia_tpu.state.posthandler import PostContext, new_post_handler
 from celestia_tpu.state.staking import StakingKeeper
 from celestia_tpu.state.store import MultiStore
 from celestia_tpu.state.tx import (
@@ -156,6 +157,8 @@ class App:
         # inner tx (commitments, no blob payloads), so entries are small.
         self._decoded_cache: "OrderedDict[bytes, tuple]" = OrderedDict()
         self._decoded_cache_max = 8192
+        # post-handler chain (posthandler.go:1-12 parity: empty default)
+        self.post_handler = new_post_handler()
 
     def _wire_keepers(self, rebuild_ibc: bool = True) -> None:
         """Re-point every keeper at the current self.store.
@@ -639,6 +642,12 @@ class App:
         try:
             for m in tx.msgs:
                 events.append(self._execute_msg(m, meter))
+            # post-handler chain (app/posthandler parity): runs on the
+            # message branch AFTER execution; a raise rolls the whole tx
+            # back with the same atomicity as a message failure
+            self.post_handler(
+                PostContext(tx=tx, app=self, events=events, gas_meter=meter)
+            )
         except Exception as e:
             return TxResult(
                 2, f"msg execution failed: {e}", tx.fee.gas_limit, meter.consumed

@@ -372,3 +372,44 @@ def test_timeout_height_decorator():
     res = signer.submit_tx([MsgSend(signer.address, sink, 7)],
                            timeout_height=h + 1)
     assert res.code == 0, res.log
+
+
+def test_posthandler_chain_runs_and_rolls_back():
+    """app/posthandler parity: the default chain is empty, but the
+    mechanism is live — a registered post decorator runs on the message
+    branch after execution, and a raising decorator rolls the whole tx
+    back atomically."""
+    from celestia_tpu.client.signer import Signer
+    from celestia_tpu.node.testnode import TestNode
+    from celestia_tpu.state.posthandler import new_post_handler
+    from celestia_tpu.state.tx import MsgSend
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+
+    alice = PrivateKey.from_seed(b"post-alice")
+    node = TestNode(funded_accounts=[(alice, 10**9)])
+    signer = Signer(node, alice)
+    bob = b"\x55" * 20
+
+    seen = []
+
+    def spy(ctx):
+        seen.append((len(ctx.tx.msgs), len(ctx.events)))
+
+    node.app.post_handler = new_post_handler((spy,))
+    r = signer.submit_tx([MsgSend(signer.address, bob, 100)])
+    assert r.code == 0
+    assert seen == [(1, 1)]
+    assert node.app.bank.balance(bob) == 100
+
+    def veto(ctx):
+        raise ValueError("post veto")
+
+    node.app.post_handler = new_post_handler((spy, veto))
+    raw = signer.sign_tx([MsgSend(signer.address, bob, 50)]).marshal()
+    res = node.broadcast_tx(raw)
+    assert res.code == 0  # CheckTx passes; the post chain runs at deliver
+    node.produce_block()
+    info = node.get_tx(res.tx_hash)
+    assert info["code"] == 2 and "post veto" in info["log"]
+    # the msg's writes rolled back with the post failure
+    assert node.app.bank.balance(bob) == 100

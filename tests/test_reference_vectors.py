@@ -2,9 +2,20 @@
 
 The reference's test corpus embeds outputs of the real Go
 nmt/rsmt2d/go-square implementations.  Pinning those exact bytes here
-means any byte-level divergence of shares -> square -> RS extension ->
-NMT roots -> data root from the Go stack fails CI — a silent regression
-in share padding or the namespace rule cannot pass.
+means any byte-level divergence of shares -> square -> NMT roots ->
+data root from the Go stack fails CI — a silent regression in share
+padding, the namespace rule, the NMT leaf/node hashing or the RFC-6962
+fold cannot pass.
+
+Precision about WHAT these vectors pin: every fixture share is
+identical (generateShares uses one constant share), and the
+interpolating polynomial through k equal values is constant, so the
+parity shares equal the data shares under ANY Reed-Solomon code.  The
+vectors therefore pin the layout/hashing machinery but are
+codec-independent — they do NOT establish parity-byte compatibility
+with the reference's Leopard codec (this repo's Lagrange codec is
+deliberately not Leopard-compatible; see README "Codec
+interoperability").
 
 Sources (all in /root/reference):
 - pkg/da/data_availability_header_test.go:29  MinDataAvailabilityHeader hash

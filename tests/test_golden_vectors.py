@@ -253,3 +253,23 @@ def test_native_commitment_matches_python_path():
             roots.append(inclusion._nmt_root_host(leaves[off : off + s]))
             off += s
         assert got == nmt_ops.rfc6962_root_np(roots).tobytes(), nbytes
+
+
+def test_glv_split_invariant_and_bounds():
+    """GLV decomposition (utils/secp256k1._glv_split): k1 + k2*lambda
+    == k (mod N) with ~128-bit components, for random and boundary
+    scalars.  Pure Python on purpose — lives here (not in
+    test_secp_native.py) so a missing native library can never skip it
+    and hide a lattice-constant regression."""
+    import secrets
+
+    from celestia_tpu.utils.secp256k1 import GLV_LAMBDA, _glv_split
+
+    cases = [1, 2, N - 1, N // 2, GLV_LAMBDA, (1 << 128) - 1, 1 << 128]
+    cases += [secrets.randbelow(N - 1) + 1 for _ in range(500)]
+    for k in cases:
+        k1, k2 = _glv_split(k)
+        assert (k1 + k2 * GLV_LAMBDA - k) % N == 0, hex(k)
+        assert abs(k1) < 1 << 129 and abs(k2) < 1 << 129, (
+            hex(k), abs(k1).bit_length(), abs(k2).bit_length()
+        )

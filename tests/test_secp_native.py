@@ -13,11 +13,13 @@ import pytest
 
 from celestia_tpu.utils import native
 from celestia_tpu.utils.secp256k1 import (
+    GLV_LAMBDA,
     Gx,
     Gy,
     N,
     PrivateKey,
     PublicKey,
+    _glv_split,
     _point_add,
     _point_mul,
     verify_batch,
@@ -124,3 +126,44 @@ def test_verify_batch_matches_pure_python_fallback():
         )
         pure.append(pt is not None and pt[0] % N == r)
     assert native_res == pure
+
+
+def test_glv_batch_matches_plain_double_mult():
+    """The native GLV path is bit-identical to the plain wNAF path for
+    random double multiplications (u1*G + u2*Q)."""
+    import numpy as np
+
+    if not native.has_glv():
+        pytest.skip("native GLV unavailable")
+    n = 32
+    u1s = np.zeros((n, 32), dtype=np.uint8)
+    u2s = np.zeros((n, 32), dtype=np.uint8)
+    ks = np.zeros((n, 128), dtype=np.uint8)
+    sg = np.zeros((n, 4), dtype=np.uint8)
+    pubs33 = np.zeros((n, 33), dtype=np.uint8)
+    pubs64 = np.zeros((n, 64), dtype=np.uint8)
+    for i in range(n):
+        u1 = secrets.randbelow(N - 1) + 1
+        u2 = secrets.randbelow(N - 1) + 1
+        pk = PrivateKey.from_seed(secrets.token_bytes(16)).public_key()
+        u1s[i] = np.frombuffer(u1.to_bytes(32, "big"), dtype=np.uint8)
+        u2s[i] = np.frombuffer(u2.to_bytes(32, "big"), dtype=np.uint8)
+        for c, k in enumerate(_glv_split(u1) + _glv_split(u2)):
+            sg[i, c] = k < 0
+            ks[i, 32 * c : 32 * (c + 1)] = np.frombuffer(
+                abs(k).to_bytes(32, "big"), dtype=np.uint8
+            )
+        pubs33[i] = np.frombuffer(pk.compressed(), dtype=np.uint8)
+        pubs64[i] = np.frombuffer(
+            pk.x.to_bytes(32, "big") + pk.y.to_bytes(32, "big"),
+            dtype=np.uint8,
+        )
+    ok1, x1 = native.ecmul_double_batch(u1s, u2s, pubs33)
+    ok2, x2 = native.ecmul_double_glv_batch(ks, sg, pubs64)
+    assert np.array_equal(ok1, ok2)
+    assert np.array_equal(x1, x2)
+    # off-curve uncompressed key must be rejected
+    bad = pubs64.copy()
+    bad[0, 63] ^= 1
+    ok3, _ = native.ecmul_double_glv_batch(ks, sg, bad)
+    assert ok3[0] == 0 and ok3[1:].all()

@@ -13,7 +13,6 @@ import pytest
 
 from celestia_tpu.utils import native
 from celestia_tpu.utils.secp256k1 import (
-    GLV_LAMBDA,
     Gx,
     Gy,
     N,
@@ -148,11 +147,11 @@ def test_glv_batch_matches_plain_double_mult():
         pk = PrivateKey.from_seed(secrets.token_bytes(16)).public_key()
         u1s[i] = np.frombuffer(u1.to_bytes(32, "big"), dtype=np.uint8)
         u2s[i] = np.frombuffer(u2.to_bytes(32, "big"), dtype=np.uint8)
-        for c, k in enumerate(_glv_split(u1) + _glv_split(u2)):
-            sg[i, c] = k < 0
-            ks[i, 32 * c : 32 * (c + 1)] = np.frombuffer(
-                abs(k).to_bytes(32, "big"), dtype=np.uint8
-            )
+        from celestia_tpu.utils.secp256k1 import _glv_pack
+
+        k_row, s_row = _glv_pack(u1, u2)
+        ks[i] = np.frombuffer(k_row, dtype=np.uint8)
+        sg[i] = np.frombuffer(s_row, dtype=np.uint8)
         pubs33[i] = np.frombuffer(pk.compressed(), dtype=np.uint8)
         pubs64[i] = np.frombuffer(
             pk.x.to_bytes(32, "big") + pk.y.to_bytes(32, "big"),

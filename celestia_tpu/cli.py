@@ -270,6 +270,7 @@ def cmd_start(args) -> int:
             node,
             [a for a in args.peers.split(",") if a],
             block_gap_s=cfg.consensus.block_interval_s,
+            logger=log.with_fields(mod="gossip"),
         )
         gossip.start()
         log.info("gossip mesh enabled", peers=len(gossip.peer_addrs))

@@ -49,6 +49,10 @@ import time as _time
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
+from celestia_tpu.utils.logging import Logger
+
+_log = Logger(level="warn")
+
 
 def wire_id(wire: dict) -> bytes:
     """Content address of a consensus wire message (dedup key)."""
@@ -88,6 +92,8 @@ class _PeerLink:
         self.engine = engine
         self.addr = addr
         self._q: deque = deque(maxlen=maxlen)  # drop-oldest on overflow
+        self.dropped = 0  # messages shed by backpressure (observable)
+        self._qlock = threading.Lock()
         self._event = threading.Event()
         self._stop = threading.Event()
         self._client = None
@@ -97,7 +103,23 @@ class _PeerLink:
         self._thread.start()
 
     def send(self, kind: str, data) -> None:
-        self._q.append((kind, data))
+        # a full deque sheds its oldest item on append; count it so
+        # silent consensus-message loss on a congested link shows up in
+        # logs/telemetry instead of only as mysterious round timeouts.
+        # Producers (pump + gRPC threads) and the consumer both take
+        # _qlock, so the len check is exact, not check-then-act.
+        with self._qlock:
+            if len(self._q) == self._q.maxlen:
+                self.dropped += 1
+                dropped = self.dropped
+            else:
+                dropped = 0
+            self._q.append((kind, data))
+        if dropped and (dropped == 1 or dropped % 256 == 0):
+            self.engine.log.warn(
+                "gossip peer backpressure: dropping oldest",
+                peer=self.addr, dropped=dropped,
+            )
         self._event.set()
 
     def stop(self) -> None:
@@ -131,10 +153,11 @@ class _PeerLink:
                 self._event.wait(timeout=0.2)
                 self._event.clear()
                 continue
-            try:
-                kind, data = self._q.popleft()
-            except IndexError:
-                continue
+            with self._qlock:
+                try:
+                    kind, data = self._q.popleft()
+                except IndexError:
+                    continue
             cli = self._ensure_client()
             if cli is None:
                 continue  # peer down; the item is dropped (flood re-sends)
@@ -169,8 +192,10 @@ class GossipEngine:
         block_gap_s: float = 0.0,
         client_timeout_s: float = 5.0,
         reannounce_s: float = 2.0,
+        logger=None,
     ):
         self.node = node
+        self.log = logger if logger is not None else _log
         self.peer_addrs = list(peer_addrs)
         self.tick_s = tick_s
         self.base_timeout_s = base_timeout_s
@@ -288,8 +313,13 @@ class GossipEngine:
         does not hold."""
         want = []
         pool = self.node.mempool
+        # snapshot the key set under the node lock once: CheckTx
+        # admissions and commit-time removals mutate the dict from other
+        # gRPC threads (same discipline as _announce_txs)
+        with self.node._service_lock:
+            pooled = set(pool._txs)
         for h in hashes:
-            if h in pool._txs or h in self._seen_tx:
+            if h in pooled or h in self._seen_tx:
                 continue
             if self.node.get_tx(h) is not None:
                 continue  # already committed

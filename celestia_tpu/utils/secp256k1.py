@@ -432,7 +432,6 @@ def verify_batch(msgs, sigs, pubkeys) -> list:
         w = ws[j]
         u1 = z * w % N
         u2 = r * w % N
-        rs[i] = r
         if use_glv:
             try:
                 raw_pub = _uncompressed64(bytes(pubkeys[i]))
@@ -446,14 +445,18 @@ def verify_batch(msgs, sigs, pubkeys) -> list:
             u1s[i] = np.frombuffer(u1.to_bytes(32, "big"), dtype=np.uint8)
             u2s[i] = np.frombuffer(u2.to_bytes(32, "big"), dtype=np.uint8)
             pubs[i] = np.frombuffer(pubkeys[i], dtype=np.uint8)
+        rs[i] = r
         live[i] = True
     if not live.any():
         return results
+    # ship only live rows: dead rows (scalar pre-check / decompression
+    # failures) would each pay the kernel's on-curve validation work
+    idx = np.flatnonzero(live)
     if use_glv:
-        ok, xs = native.ecmul_double_glv_batch(ks, sgn, pubs)
+        ok, xs = native.ecmul_double_glv_batch(ks[idx], sgn[idx], pubs[idx])
     else:
-        ok, xs = native.ecmul_double_batch(u1s, u2s, pubs)
-    for i in range(n):
-        if live[i] and ok[i]:
-            results[i] = int.from_bytes(xs[i].tobytes(), "big") % N == rs[i]
+        ok, xs = native.ecmul_double_batch(u1s[idx], u2s[idx], pubs[idx])
+    for j, i in enumerate(idx):
+        if ok[j]:
+            results[i] = int.from_bytes(xs[j].tobytes(), "big") % N == rs[i]
     return results

@@ -31,20 +31,55 @@ static std::vector<uint8_t> from_hex(const std::string& s) {
 
 static std::string json_escape(const uint8_t* p, size_t n) {
     // memo bytes are attacker-chosen; quotes/backslashes/control chars
-    // must not corrupt the decoder's own JSON output
+    // must not corrupt the decoder's own JSON output, and the output
+    // must always be valid UTF-8.  The Python encoder writes memos as
+    // UTF-8 (state/tx.py Tx.marshal: memo.encode()), so well-formed
+    // sequences pass through verbatim — escaping them would diverge
+    // from the Python decode of the same bytes — and only malformed
+    // bytes are replaced (U+FFFD), keeping strict JSON parsers happy.
     std::string out;
     out.reserve(n);
-    for (size_t i = 0; i < n; i++) {
+    size_t i = 0;
+    while (i < n) {
         uint8_t c = p[i];
         if (c == '"' || c == '\\') {
             out += '\\';
             out += (char)c;
+            i++;
         } else if (c < 0x20) {
             char buf[8];
             snprintf(buf, sizeof(buf), "\\u%04x", c);
             out += buf;
-        } else {
+            i++;
+        } else if (c < 0x80) {
             out += (char)c;
+            i++;
+        } else {
+            // validate one multi-byte sequence: length from the lead
+            // byte, continuation bytes, overlongs, surrogates, >10FFFF
+            size_t len = 0;
+            uint32_t cp = 0;
+            if (c >= 0xC2 && c <= 0xDF) { len = 2; cp = c & 0x1F; }
+            else if (c >= 0xE0 && c <= 0xEF) { len = 3; cp = c & 0x0F; }
+            else if (c >= 0xF0 && c <= 0xF4) { len = 4; cp = c & 0x07; }
+            bool ok = len != 0 && i + len <= n;
+            for (size_t j = 1; ok && j < len; j++) {
+                uint8_t cc = p[i + j];
+                ok = (cc & 0xC0) == 0x80;
+                cp = (cp << 6) | (cc & 0x3F);
+            }
+            if (ok && len == 3 &&
+                (cp < 0x800 || (cp >= 0xD800 && cp <= 0xDFFF)))
+                ok = false;
+            if (ok && len == 4 && (cp < 0x10000 || cp > 0x10FFFF))
+                ok = false;
+            if (ok) {
+                out.append((const char*)(p + i), len);
+                i += len;
+            } else {
+                out += "\xEF\xBF\xBD";  // U+FFFD replacement character
+                i++;
+            }
         }
     }
     return out;

@@ -74,6 +74,25 @@ def test_cpp_decodes_signed_tx(decoder):
     assert got["signature"] == tx.signature.hex()
 
 
+def test_cpp_decodes_utf8_memo(decoder):
+    """Non-ASCII memos must survive the C++ leg byte-identically: the
+    Python encoder writes memos as UTF-8 (state/tx.py Tx.marshal), so the
+    decoder must pass well-formed sequences through rather than escaping
+    each byte (which would diverge from the Python decode of the same
+    wire bytes), while still emitting valid-UTF-8 JSON for quotes,
+    control bytes, and backslashes."""
+    key = PrivateKey.from_seed(b"wire-spec-utf8")
+    msg = MsgSend(key.public_key().address(), b"\x01" * 20, 1)
+    memo = 'héllo ✓ 🚀 "q\\b"\ttab'
+    tx = Tx(
+        msgs=(msg,), fee=Fee(1, 1),
+        pubkey=key.public_key().compressed(), sequence=0,
+        account_number=0, memo=memo,
+    ).signed(key, "wire-chain-1")
+    got = decoder("tx", tx.marshal().hex())
+    assert got["memo"] == memo
+
+
 def test_cpp_decodes_blobtx_envelope(decoder):
     _, _, tx = _signed_send_tx()
     blob = Blob(Namespace.v0(b"\x05" * 10), b"wire spec blob " * 10)

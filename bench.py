@@ -34,12 +34,50 @@ vs_baseline = cpu_ms / device_ms (speedup; >1 is faster than the CPU leg).
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 K = int(os.environ.get("BENCH_K", "128"))
 BATCH = int(os.environ.get("BENCH_BATCH", "8"))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+
+
+def _device_available() -> bool:
+    """Probe the accelerator backend in a CHILD process with a timeout.
+
+    BENCH_r04 recorded rc:1/parsed:null because a dead axon tunnel killed
+    the whole bench at backend init — and the failure mode is worse than a
+    raise: backend init can HANG for minutes.  An in-process try/except
+    cannot protect against that, so the probe runs `jax.devices()` in a
+    subprocess and a timeout/-nonzero rc demotes the run to host-only
+    legs (device: unavailable, exit 0) instead of zeroing the round's
+    evidence (VERDICT r4 weak #1)."""
+    code = (
+        "import jax\n"
+        "ds = jax.devices()\n"
+        "assert ds\n"
+        "print('BENCH_PROBE_OK', ds[0].platform)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    if proc.returncode != 0:
+        return False
+    for line in proc.stdout.decode("utf-8", "replace").splitlines():
+        if line.startswith("BENCH_PROBE_OK"):
+            platform = line.split()[-1].lower()
+            # a silent CPU fallback is NOT a device: the k=128 programs
+            # take minutes to compile on XLA CPU (driver timeout) and
+            # the numbers would be mislabeled as device figures
+            return platform not in ("cpu", "bench_probe_ok")
+    return False
 
 
 def _chain_fn(k: int, r: int, batch: int = 0):
@@ -361,7 +399,98 @@ def _prepare_proposal_ms(k: int):
     return float(np.median(times)), prop.square_size, len(txs), breakdown
 
 
+def _glv_us_per_sig(n: int = 256):
+    """Native batched ECDSA verify, µs per signature (ADR-011 host leg) —
+    8 distinct senders so the pubkey-decompression cache behaves like a
+    proposal (senders repeat).  Raises when the native kernel is absent:
+    verify_batch would silently fall back to pure Python there, and that
+    figure must never be recorded under the GLV key."""
+    from celestia_tpu.utils import native
+    from celestia_tpu.utils.secp256k1 import PrivateKey, verify_batch
+
+    if not (native.available() and native.has_glv()):
+        raise RuntimeError("native GLV kernel unavailable")
+
+    keys = [PrivateKey.from_seed(b"bench-glv-%d" % (i % 8)) for i in range(n)]
+    msgs = [b"bench-glv-msg-%d" % i for i in range(n)]
+    sigs = [key.sign(m) for key, m in zip(keys, msgs)]
+    pubs = [key.public_key().compressed() for key in keys]
+    out = verify_batch(msgs, sigs, pubs)  # warm
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        out = verify_batch(msgs, sigs, pubs)
+        times.append((time.time() - t0) * 1e6 / n)
+    assert all(out), "bench GLV verify failed on valid signatures"
+    return float(np.median(times))
+
+
+def _dah_128_fixture_match() -> bool:
+    """Run the Go stack's 128x128 fixture through the DEVICE pipeline and
+    compare against the pinned hash (VERDICT r4 weak #4: the test suite
+    only ties the 128 vector to the native C++ leg because XLA CPU takes
+    minutes to compile it; on the real chip the compile is seconds, so
+    the bench asserts the fixture on-device every round).  Vector + share
+    construction live in celestia_tpu.da.golden, shared with the tests."""
+    from celestia_tpu.da import dah as dah_mod
+    from celestia_tpu.da.golden import DAH_128_HASH, fixture_shares
+
+    eds = dah_mod.extend_shares(fixture_shares(128 * 128))
+    dah = dah_mod.new_data_availability_header(eds)
+    return dah.hash == DAH_128_HASH
+
+
+def _host_only_main():
+    """Device backend unreachable: record every host-side leg with
+    device: unavailable and exit 0 — a tunnel outage must never zero a
+    round's evidence again (VERDICT r4 #1)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    extras = {"device": "unavailable"}
+    try:
+        cpu_ms = _cpu_ms(K)
+    except Exception as e:
+        cpu_ms = None
+        extras["cpu_error"] = repr(e)[:200]
+    if cpu_ms is not None:
+        extras["cpu_leg"] = "table_gf_cpu"
+        extras[f"extend_block_{K}_table_gf_cpu_ms"] = round(cpu_ms, 1)
+        extras["cpu_threads"] = os.cpu_count()
+    try:
+        extras["filter_512_pfb_ms"] = round(_filter_txs_ms(512), 1)
+    except Exception as e:
+        extras["filter_error"] = repr(e)[:200]
+    try:
+        extras["glv_us_per_sig"] = round(_glv_us_per_sig(), 1)
+    except Exception as e:
+        extras["glv_error"] = repr(e)[:200]
+    print(
+        json.dumps(
+            {
+                "metric": f"extend_block_{K}x{K}_table_gf_cpu_ms",
+                "value": round(cpu_ms, 1) if cpu_ms is not None else 0.0,
+                "unit": "ms",
+                "vs_baseline": 0.0,
+                "extras": extras,
+            }
+        )
+    )
+
+
 def main():
+    if os.environ.get("_BENCH_HOST_ONLY") == "1":
+        _host_only_main()
+        return
+    if not _device_available():
+        # re-exec with the CPU platform pinned BEFORE jax can initialise:
+        # sitecustomize may force the axon backend regardless of late
+        # JAX_PLATFORMS writes (same re-exec dance as dryrun_multichip)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["_BENCH_HOST_ONLY"] = "1"
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env)
+        sys.exit(proc.returncode)
     k = K
     extras = {}
     device_ms = _amortized_device_ms(k)
@@ -429,6 +558,16 @@ def main():
         extras[f"batch{BATCH}x{k}_per_square_ms"] = round(batch_ms / BATCH, 3)
     except Exception as e:
         extras["batch_error"] = repr(e)[:200]
+    try:
+        extras["glv_us_per_sig"] = round(_glv_us_per_sig(), 1)
+    except Exception as e:
+        extras["glv_error"] = repr(e)[:200]
+    try:
+        # Go-fixture gate on the DEVICE path (only meaningful at k=128)
+        if k == 128:
+            extras["dah_128_fixture_match"] = bool(_dah_128_fixture_match())
+    except Exception as e:
+        extras["dah_128_fixture_error"] = repr(e)[:200]
 
     vs = round(cpu_ms / device_ms, 1) if cpu_ms else 0.0
     print(

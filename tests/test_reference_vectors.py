@@ -48,34 +48,15 @@ from celestia_tpu.da.inclusion import create_commitment
 from celestia_tpu.da.namespace import Namespace
 from celestia_tpu.utils import native
 
-# pkg/da/data_availability_header_test.go:29
-MIN_DAH_HASH = bytes.fromhex(
-    "3d96b7d238e7e0456f6af8e7cdf0a67bd6cf9c2089ecb559c659dcaa1f880353"
+# Pinned bytes + fixture-share construction live in celestia_tpu.da.golden,
+# shared with bench.py's on-device fixture gate.
+from celestia_tpu.da.golden import (  # noqa: F401
+    DAH_2X2_HASH,
+    DAH_128_HASH,
+    MIN_DAH_HASH,
+    fixture_share as _fixture_share,
+    fixture_shares as _fixture_shares,
 )
-# pkg/da/data_availability_header_test.go:45 ("typical", squareSize=2)
-DAH_2X2_HASH = bytes.fromhex(
-    "b56e4d251ac266f4b91cc5464b3fc7efcbdc888064647496d13133f0dc65ac25"
-)
-# pkg/da/data_availability_header_test.go:51 ("max square size", 128)
-DAH_128_HASH = bytes.fromhex(
-    "0bd3abeeacfbb0b92dfbdac4a154868e3c4e79666f7fcf6c620bb90dd3a0dcf0"
-)
-
-
-def _fixture_share() -> bytes:
-    """generateShare(ns1) parity: ns1 = MustNewV0(10 x 0x01), remainder
-    0xFF to ShareSize."""
-    ns1 = Namespace.v0(b"\x01" * 10)
-    share = ns1.raw + b"\xff" * (SHARE_SIZE - len(ns1.raw))
-    assert len(share) == SHARE_SIZE
-    return share
-
-
-def _fixture_shares(count: int) -> np.ndarray:
-    share = _fixture_share()
-    return np.frombuffer(share * count, dtype=np.uint8).reshape(
-        count, SHARE_SIZE
-    )
 
 
 def test_min_dah_matches_go_fixture():

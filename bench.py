@@ -12,15 +12,17 @@ Covers the BASELINE.md configs:
   committed-root verification.
 - #5: batched 8x128x128 squares on one chip (batch dim; per-square ms).
 
-CPU comparison leg (`table_gf_cpu`): the native threaded C++ pipeline
-(native/celestia_native.cpp extend_block_cpu — table-method O(k^2) GF(256)
-+ SHA-256 at -O3, all cores), run at the FULL size with no extrapolation.
-It plays the ROLE of the reference's Leopard-RS SIMD codec + crypto/sha256
-(pkg/da/data_availability_header.go:44-75) but is NOT Leopard — Leopard is
-O(n log n) with hand-written assembly, so vs_baseline overstates what a
-true Leopard comparison would show (no Go toolchain on the bench host;
-BASELINE.md).  The leg name and cpu_threads ride in extras so the number
-is never quoted without that caveat.
+CPU comparison legs, both at FULL size with no extrapolation:
+
+- `leopard_cpu` (the honest baseline, vs_baseline denominator): the
+  in-tree Leopard codec — O(n log n) LCH FFT with the pshufb 4-bit-split
+  SIMD multiply kernel real Leopard uses (native leo_encode,
+  byte-identical to the device path, ADR-012) + the same threaded
+  SHA-256/NMT stage.  This is the algorithm class of the reference's
+  codec (pkg/da/data_availability_header.go:44-75), so the ≥10x
+  BASELINE.md target is finally measured, not extrapolated.
+- `table_gf_cpu`: the O(k^2) table-method pipeline, kept for continuity
+  with earlier rounds' numbers.
 
 Device timing uses dependent-chain amortization where transfer is excluded:
 the axon tunnel adds ~60-90 ms fixed round-trip per call, so chained
@@ -178,6 +180,36 @@ def _cpu_ms(k: int):
         native.extend_block_cpu(sq, nthreads=0)
         times.append((time.time() - t0) * 1000.0)
     return float(np.median(times))
+
+
+def _leopard_cpu_ms(k: int):
+    """The HONEST CPU baseline (BASELINE.md ≥10x target, unmeasured
+    through r04): full ExtendBlock via the in-tree Leopard codec — the
+    O(n log n) LCH FFT with the same pshufb 4-bit-split SIMD multiply
+    kernel real Leopard uses (native/celestia_native.cpp leo_encode,
+    byte-identical to the device path per tests/test_leopard_codec.py) —
+    plus the same SHA/NMT stage as the table leg.  Returns
+    (full_pipeline_ms, extension_only_ms)."""
+    from celestia_tpu.utils import native
+
+    if not native.available():
+        return None, None
+    rng = np.random.default_rng(1)
+    sq = np.ascontiguousarray(
+        rng.integers(0, 256, (k, k, 512), dtype=np.uint8)
+    )
+    native.extend_block_leopard_cpu(sq, nthreads=0)  # warm tables
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        native.extend_block_leopard_cpu(sq, nthreads=0)
+        times.append((time.time() - t0) * 1000.0)
+    ext_times = []
+    for _ in range(3):
+        t0 = time.time()
+        native.leo_extend_square(sq, nthreads=0)
+        ext_times.append((time.time() - t0) * 1000.0)
+    return float(np.median(times)), float(np.median(ext_times))
 
 
 def _repair_ms(k: int):
@@ -458,6 +490,15 @@ def _host_only_main():
         extras[f"extend_block_{K}_table_gf_cpu_ms"] = round(cpu_ms, 1)
         extras["cpu_threads"] = os.cpu_count()
     try:
+        leo_ms, leo_ext_ms = _leopard_cpu_ms(K)
+        if leo_ms is not None:
+            extras["cpu_leg"] = "leopard_cpu"
+            extras[f"extend_block_{K}_leopard_cpu_ms"] = round(leo_ms, 1)
+            extras["leopard_extension_only_ms"] = round(leo_ext_ms, 1)
+            cpu_ms = leo_ms
+    except Exception as e:
+        extras["leopard_error"] = repr(e)[:200]
+    try:
         extras["filter_512_pfb_ms"] = round(_filter_txs_ms(512), 1)
     except Exception as e:
         extras["filter_error"] = repr(e)[:200]
@@ -465,10 +506,11 @@ def _host_only_main():
         extras["glv_us_per_sig"] = round(_glv_us_per_sig(), 1)
     except Exception as e:
         extras["glv_error"] = repr(e)[:200]
+    leg = extras.get("cpu_leg", "table_gf_cpu")
     print(
         json.dumps(
             {
-                "metric": f"extend_block_{K}x{K}_table_gf_cpu_ms",
+                "metric": f"extend_block_{K}x{K}_{leg}_ms",
                 "value": round(cpu_ms, 1) if cpu_ms is not None else 0.0,
                 "unit": "ms",
                 "vs_baseline": 0.0,
@@ -497,13 +539,23 @@ def main():
     extras[f"extend_block_{k}_device_ms"] = round(device_ms, 3)
     cpu_ms = _cpu_ms(k)
     if cpu_ms is not None:
-        # HONEST LABEL: the CPU leg is the in-repo threaded table-method
-        # GF(256) + SHA-256 C++ pipeline (O(k^2)), NOT Leopard (O(n log n)
-        # SIMD asm) — vs_baseline therefore overstates a Leopard
-        # comparison; quote it only with the leg name + cpu_threads.
-        extras["cpu_leg"] = "table_gf_cpu"
         extras[f"extend_block_{k}_table_gf_cpu_ms"] = round(cpu_ms, 1)
         extras["cpu_threads"] = os.cpu_count()
+    try:
+        leo_ms, leo_ext_ms = _leopard_cpu_ms(k)
+    except Exception as e:  # never let a CPU leg kill the device evidence
+        leo_ms, leo_ext_ms = None, None
+        extras["leopard_error"] = repr(e)[:200]
+    if leo_ms is not None:
+        # the honest baseline leg (BASELINE ≥10x target): Leopard-class
+        # O(n log n) FFT + pshufb SIMD multiply, full pipeline at full
+        # size on this host; extension_only isolates the codec itself
+        extras["cpu_leg"] = "leopard_cpu"
+        extras[f"extend_block_{k}_leopard_cpu_ms"] = round(leo_ms, 1)
+        extras["leopard_extension_only_ms"] = round(leo_ext_ms, 1)
+        cpu_ms = leo_ms  # vs_baseline compares against the leopard leg
+    elif cpu_ms is not None:
+        extras["cpu_leg"] = "table_gf_cpu"
     e2e_ms = _e2e_extend_ms(k)
     extras[f"extend_block_{k}_e2e_single_call_ms"] = round(e2e_ms, 2)
     extras["transfer_overhead_ms"] = round(e2e_ms - device_ms, 2)

@@ -28,6 +28,7 @@ from celestia_tpu.appconsts import (
 from celestia_tpu.da.square import Square
 from celestia_tpu.ops import nmt as nmt_ops
 from celestia_tpu.ops import rs
+from celestia_tpu.ops.gf256 import active_codec as _active_codec
 from celestia_tpu.ops.gf256 import encode_matrix_bits
 
 NMT_ROOT_SIZE = nmt_ops.NMT_DIGEST_SIZE  # 90
@@ -91,10 +92,10 @@ class ExtendedDataSquare:
 
 
 @lru_cache(maxsize=None)
-def _extend_and_roots_fn(k: int):
+def _extend_and_roots_fn(k: int, codec: str):
     """Jitted fused pipeline for square size k:
     square uint8[k,k,512] -> (eds, row_roots[2k,90], col_roots[2k,90], data_root[32])."""
-    G = jnp.asarray(encode_matrix_bits(k))
+    G = jnp.asarray(encode_matrix_bits(k, codec))
 
     def run(square: jnp.ndarray):
         eds = rs._extend(square, G)
@@ -201,7 +202,7 @@ def extend_and_header(
     """
     square = np.asarray(square, dtype=np.uint8)
     k = square.shape[0]
-    eds_d, row_roots, col_roots, data_root = _extend_and_roots_fn(k)(
+    eds_d, row_roots, col_roots, data_root = _extend_and_roots_fn(k, _active_codec())(
         jnp.asarray(square)
     )
     eds = ExtendedDataSquare(eds_d)  # stays on device until shares are read
@@ -230,7 +231,7 @@ def extend_and_header_breakdown(square: np.ndarray):
     dev = jax.device_put(jnp.asarray(square))
     dev.block_until_ready()
     t1 = _t.time()
-    out = _extend_and_roots_fn(k)(dev)
+    out = _extend_and_roots_fn(k, _active_codec())(dev)
     jax.block_until_ready(out)
     t2 = _t.time()
     eds_d, row_roots, col_roots, data_root = out

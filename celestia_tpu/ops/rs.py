@@ -73,8 +73,9 @@ def _extend(square: jnp.ndarray, G: jnp.ndarray) -> jnp.ndarray:
 
 
 @lru_cache(maxsize=None)
-def _extend_fn(k: int):
-    G = jnp.asarray(gf256.encode_matrix_bits(k))
+def _extend_fn(k: int, codec: str):
+    # codec required — see _repair_verify_fn
+    G = jnp.asarray(gf256.encode_matrix_bits(k, codec))
     return jax.jit(partial(_extend, G=G))
 
 
@@ -84,12 +85,12 @@ def extend_square(square) -> jnp.ndarray:
     k = square.shape[0]
     if square.shape[1] != k or not is_power_of_two(k):
         raise ValueError(f"square must be (k, k, B) with k a power of two, got {square.shape}")
-    return _extend_fn(k)(square)
+    return _extend_fn(k, gf256.active_codec())(square)
 
 
 @lru_cache(maxsize=None)
-def _extend_batched_fn(k: int):
-    G = jnp.asarray(gf256.encode_matrix_bits(k))
+def _extend_batched_fn(k: int, codec: str):
+    G = jnp.asarray(gf256.encode_matrix_bits(k, codec))
     return jax.jit(jax.vmap(partial(_extend, G=G)))
 
 
@@ -101,7 +102,7 @@ def extend_squares_batched(squares) -> jnp.ndarray:
         raise ValueError(
             f"batch must be (n, k, k, B) with k a power of two, got {squares.shape}"
         )
-    return _extend_batched_fn(k)(squares)
+    return _extend_batched_fn(k, gf256.active_codec())(squares)
 
 
 # ---------------------------------------------------------------------------
@@ -118,24 +119,30 @@ def extend_squares_batched(squares) -> jnp.ndarray:
 # phases.
 # ---------------------------------------------------------------------------
 
-def _gf_tables_dev():
+def _gf_tables_dev(codec: str = None):
     # created per call, NOT cached: importing this module must not
     # initialize a jax backend, and a cached array captured inside a
     # traced scope would leak a tracer into later traces.  XLA folds
     # the repeated constants, so per-call creation costs nothing.
+    exp, log = gf256.field_tables(codec)
     return (
-        jnp.asarray(gf256.GF_EXP, dtype=jnp.int32),
-        jnp.asarray(gf256.GF_LOG, dtype=jnp.int32),
+        jnp.asarray(exp, dtype=jnp.int32),
+        jnp.asarray(log, dtype=jnp.int32),
     )
 
 
-def _decode_matrices_dev(known: jnp.ndarray, k: int) -> jnp.ndarray:
+def _decode_matrices_dev(
+    known: jnp.ndarray, k: int, codec: str = None
+) -> jnp.ndarray:
     """Device port of gf256.decode_matrices_batch: known uint8[n, k]
-    (distinct points per row — guaranteed by the host scheduler) ->
-    D uint8[n, 2k, k]."""
-    exp, log = _gf_tables_dev()
-    src = known.astype(jnp.int32)  # [n, k]
-    dst = jnp.arange(2 * k, dtype=jnp.int32)
+    (distinct POSITIONS per row — guaranteed by the host scheduler) ->
+    D uint8[n, 2k, k].  Position -> field-point mapping is XOR with k
+    under the leopard codec (gf256.position_points)."""
+    codec = gf256._resolve(codec)
+    exp, log = _gf_tables_dev(codec)
+    xor_const = k if codec == gf256.CODEC_LEOPARD else 0
+    src = known.astype(jnp.int32) ^ xor_const  # [n, k]
+    dst = jnp.arange(2 * k, dtype=jnp.int32) ^ xor_const
     diff_ss = src[:, None, :] ^ src[:, :, None]  # [n, j, m]
     diff_ss = diff_ss.at[:, jnp.arange(k), jnp.arange(k)].set(1)
     denom_log = log[diff_ss].sum(axis=2) % 255  # [n, j]
@@ -152,26 +159,27 @@ def _decode_matrices_dev(known: jnp.ndarray, k: int) -> jnp.ndarray:
     ).astype(jnp.uint8)
 
 
-@lru_cache(maxsize=1)
-def _bit_basis():
+@lru_cache(maxsize=None)
+def _bit_basis(codec: str):
     """B[u, s, t] = bit s of gf_mul(2^u, 2^t) — the GF(2) lift is LINEAR
     in the operand's bits: M(a)[s,t] = XOR_u a_u * B[u,s,t].  Expanding a
     matrix therefore needs no table gathers (slow on TPU), just one tiny
-    contraction over u against this 8x8x8 constant."""
+    contraction over u against this 8x8x8 constant.  Holds in both codec
+    representations (the Cantor-index map is GF(2)-linear)."""
     powers = np.uint8(1) << np.arange(8, dtype=np.uint8)
-    prod = gf256.gf_mul(powers[:, None], powers[None, :])  # [u, t]
+    prod = gf256.gf_mul(powers[:, None], powers[None, :], codec)  # [u, t]
     s = np.arange(8, dtype=np.uint8)
     return ((prod[:, None, :] >> s[None, :, None]) & 1).astype(np.int8)
 
 
-def _bit_expand_dev(D: jnp.ndarray) -> jnp.ndarray:
+def _bit_expand_dev(D: jnp.ndarray, codec: str = None) -> jnp.ndarray:
     """Device port of gf256.bit_expand_matrix, batched: uint8[n, m, c] ->
     int8 0/1 [n, 8m, 8c].  Gather-free: unpack D's bits, contract with
     the constant bit basis, mod 2."""
     n, m, c = D.shape
     u = jnp.arange(8, dtype=jnp.uint8)
     a_bits = ((D[:, :, :, None] >> u) & 1).astype(jnp.int8)  # [n, m, c, u]
-    B = jnp.asarray(_bit_basis())  # [u, s, t]
+    B = jnp.asarray(_bit_basis(gf256._resolve(codec)))  # [u, s, t]
     acc = jnp.einsum(
         "nmcu,ust->nmsct", a_bits, B, preferred_element_type=jnp.int32
     )
@@ -180,19 +188,21 @@ def _bit_expand_dev(D: jnp.ndarray) -> jnp.ndarray:
 
 
 def _decode_axes_dev(
-    data: jnp.ndarray, known: jnp.ndarray, k: int, chunk: int
+    data: jnp.ndarray, known: jnp.ndarray, k: int, chunk: int,
+    codec: str = None,
 ) -> jnp.ndarray:
     """Decode ALL 2k axes of one orientation: data uint8[2k, 2k, B]
     (axis-major), known uint8[2k, k] -> decoded uint8[2k, 2k, B].
     Chunked over axes to bound the D_bits working set."""
+    codec = gf256._resolve(codec)
     n2 = 2 * k
     B = data.shape[2]
     X = jnp.take_along_axis(data, known[:, :, None].astype(jnp.int32), axis=1)
 
     def one_chunk(args):
         Xc, knownc = args  # [chunk, k, B], [chunk, k]
-        D = _decode_matrices_dev(knownc, k)  # [chunk, 2k, k]
-        D_bits = _bit_expand_dev(D)  # [chunk, 16k, 8k]
+        D = _decode_matrices_dev(knownc, k, codec)  # [chunk, 2k, k]
+        D_bits = _bit_expand_dev(D, codec)  # [chunk, 16k, 8k]
         X_bits = unpack_bits(Xc)  # [chunk, 8k, B]
         out_bits = matmul_gf2(D_bits, X_bits)  # [chunk, 16k, B]
         return pack_bits(out_bits)  # [chunk, 2k, B]
@@ -213,14 +223,16 @@ def _repair_phases(
     col_mask: jnp.ndarray,
     k: int,
     chunk: int,
+    codec: str = None,
 ) -> jnp.ndarray:
     """P peeling phases (rows then columns each), fully on device."""
+    codec = gf256._resolve(codec)
     P = row_known.shape[0]
     for p in range(P):  # P is static: unrolled into one XLA program
-        decoded = _decode_axes_dev(eds, row_known[p], k, chunk)
+        decoded = _decode_axes_dev(eds, row_known[p], k, chunk, codec)
         eds = jnp.where(row_mask[p][:, None, None], decoded, eds)
         edsT = eds.transpose(1, 0, 2)
-        decodedT = _decode_axes_dev(edsT, col_known[p], k, chunk)
+        decodedT = _decode_axes_dev(edsT, col_known[p], k, chunk, codec)
         edsT = jnp.where(col_mask[p][:, None, None], decodedT, edsT)
         eds = edsT.transpose(1, 0, 2)
     return eds
@@ -228,7 +240,7 @@ def _repair_phases(
 
 def _repair_verify(
     eds, avail, row_known, row_mask, col_known, col_mask, *, k: int,
-    chunk: int, with_roots: bool,
+    chunk: int, with_roots: bool, codec: str = None,
 ):
     """Phases + BOTH byzantine checks (+ axis roots) fused into ONE
     device program — a repairing light/full node pays a single round trip
@@ -238,10 +250,12 @@ def _repair_verify(
     square against it AT AVAILABLE CELLS is exactly the provided-share
     consistency check (rsmt2d ErrByzantine for shares the peeling
     schedule overwrote)."""
+    codec = gf256._resolve(codec)
     repaired = _repair_phases(
-        eds, row_known, row_mask, col_known, col_mask, k=k, chunk=chunk
+        eds, row_known, row_mask, col_known, col_mask, k=k, chunk=chunk,
+        codec=codec,
     )
-    G = jnp.asarray(gf256.encode_matrix_bits(k))
+    G = jnp.asarray(gf256.encode_matrix_bits(k, codec))
     recomputed = _extend(repaired[:k, :k], G)
     mismatch = jnp.any(repaired != recomputed, axis=2)  # [2k, 2k] bool
     provided_mismatch = avail & jnp.any(repaired != eds, axis=2)
@@ -262,9 +276,17 @@ _MAX_DEVICE_PHASES = 4
 
 
 @lru_cache(maxsize=8)
-def _repair_verify_fn(k: int, phases: int, chunk: int, with_roots: bool):
+def _repair_verify_fn(
+    k: int, phases: int, chunk: int, with_roots: bool, codec: str
+):
+    # codec is REQUIRED (resolved by the caller): a None default resolved
+    # in here would cache the first-build codec under key None and serve
+    # a stale program after a codec switch
     return jax.jit(
-        partial(_repair_verify, k=k, chunk=chunk, with_roots=with_roots)
+        partial(
+            _repair_verify, k=k, chunk=chunk, with_roots=with_roots,
+            codec=codec,
+        )
     )
 
 
@@ -372,7 +394,9 @@ def repair_square_device(
     # the index tensors upload and the program dispatches (VERDICT r3 #6)
     masked_dev = jnp.asarray(masked)
     t1 = _t.time()
-    fn = _repair_verify_fn(k, P, chunk, with_roots)
+    # codec resolved HERE (not inside the lru_cached builder) so a codec
+    # switch can never serve a stale cached program
+    fn = _repair_verify_fn(k, P, chunk, with_roots, gf256.active_codec())
     repaired_dev, mismatch_dev, provided_mismatch_dev, roots_dev = fn(
         masked_dev, jnp.asarray(avail),
         jnp.asarray(rk), jnp.asarray(rm),
@@ -448,10 +472,11 @@ def _gf_matmul_axes_host(D: np.ndarray, X: np.ndarray) -> np.ndarray:
 
     if native.available():
         return native.gf_matmul_axes(D, X)
+    exp, log = gf256.field_tables()  # active codec's representation
     n, R, k = D.shape
     B = X.shape[2]
     out = np.zeros((n, R, B), dtype=np.uint8)
-    logX = gf256.GF_LOG[X.astype(np.int32)]  # [n, k, B]
+    logX = log[X.astype(np.int32)]  # [n, k, B]
     for i in range(n):
         acc = out[i]
         for j in range(k):
@@ -459,8 +484,8 @@ def _gf_matmul_axes_host(D: np.ndarray, X: np.ndarray) -> np.ndarray:
             nz = col != 0
             if not nz.any():
                 continue
-            prod = gf256.GF_EXP[
-                (gf256.GF_LOG[col[nz].astype(np.int32)][:, None] + logX[i, j][None, :])
+            prod = exp[
+                (log[col[nz].astype(np.int32)][:, None] + logX[i, j][None, :])
                 % 255
             ].astype(np.uint8)
             prod[:, X[i, j] == 0] = 0

@@ -37,6 +37,7 @@ from jax.experimental.shard_map import shard_map
 from celestia_tpu.appconsts import NAMESPACE_SIZE, SHARE_SIZE
 from celestia_tpu.ops import nmt as nmt_ops
 from celestia_tpu.ops import rs
+from celestia_tpu.ops.gf256 import active_codec as _active_codec
 from celestia_tpu.ops.gf256 import encode_matrix_bits
 from celestia_tpu.ops.nmt import NMT_DIGEST_SIZE, _PARITY_NS
 
@@ -155,11 +156,11 @@ def _sharded_extend_and_roots(square_shard: jnp.ndarray, G: jnp.ndarray, k: int,
 
 
 @lru_cache(maxsize=None)
-def _sharded_fn(mesh: Mesh, k: int, batched: bool):
+def _sharded_fn(mesh: Mesh, k: int, batched: bool, codec: str):
     R = mesh.shape["row"]
     if k % R:
         raise ValueError(f"square size {k} not divisible by row shards {R}")
-    G = jnp.asarray(encode_matrix_bits(k))
+    G = jnp.asarray(encode_matrix_bits(k, codec))
     body = partial(_sharded_extend_and_roots, G=G, k=k, n_row_shards=R)
 
     if not batched:
@@ -202,7 +203,7 @@ def extend_and_roots_sharded(square: np.ndarray, mesh: Mesh):
     k = square.shape[0]
     sharding = NamedSharding(mesh, P("row", None, None))
     x = jax.device_put(jnp.asarray(square), sharding)
-    eds_local, row_roots, col_roots, data_root = _sharded_fn(mesh, k, False)(x)
+    eds_local, row_roots, col_roots, data_root = _sharded_fn(mesh, k, False, _active_codec())(x)
     eds = _reassemble_eds(np.asarray(eds_local), k)
     return eds, np.asarray(row_roots), np.asarray(col_roots), np.asarray(data_root)
 
@@ -215,7 +216,7 @@ def extend_and_roots_sharded_batch(squares: np.ndarray, mesh: Mesh):
     n, k = squares.shape[0], squares.shape[1]
     sharding = NamedSharding(mesh, P("data", "row", None, None))
     x = jax.device_put(jnp.asarray(squares), sharding)
-    eds_local, row_roots, col_roots, data_roots = _sharded_fn(mesh, k, True)(x)
+    eds_local, row_roots, col_roots, data_roots = _sharded_fn(mesh, k, True, _active_codec())(x)
     eds_local = np.asarray(eds_local)
     eds = np.stack([_reassemble_eds(eds_local[i], k) for i in range(n)])
     return eds, np.asarray(row_roots), np.asarray(col_roots), np.asarray(data_roots)

@@ -131,6 +131,9 @@ class App:
         self.chain_id = chain_id
         self.min_gas_price = min_gas_price  # node-local CheckTx filter
         self.v2_upgrade_height = v2_upgrade_height  # v1 height-based path
+        from celestia_tpu.ops import gf256 as _gf256
+
+        self.codec = _gf256.active_codec()  # re-pinned by init_chain
         self.store = MultiStore(STORE_NAMES)
         self._wire_keepers()
         self.telemetry = Telemetry()
@@ -249,6 +252,17 @@ class App:
         }
         """
         self.chain_id = genesis.get("chain_id", self.chain_id)
+        # The share codec is a consensus constant pinned at genesis
+        # (ADR-012): "leopard-ff8" (default; parity-byte compatible with
+        # the reference chain's Leopard codec) or "lagrange-gf256".
+        # Persisted in-store so a disk-recovered node re-activates it
+        # without a side channel.
+        from celestia_tpu.ops import gf256 as _gf256
+
+        codec = genesis.get("codec", _gf256.CODEC_LEOPARD)
+        _gf256.set_active_codec(codec)  # raises on unknown codec
+        self.codec = codec
+        self.store.store("meta").set(b"codec", codec.encode())
         set_default_params(self.params)
         for subspace, kvs in genesis.get("params", {}).items():
             for k, v in kvs.items():
@@ -886,8 +900,20 @@ class App:
             "chain_id": self.chain_id,
             "app_version": self.app_version,
             "genesis_time_ns": self.genesis_time_ns,
+            "codec": self.codec,
             "state": self.store.export(),
         }
+
+    def _restore_codec_from_meta(self) -> None:
+        """Re-activate the codec a restored state was created under.
+        Legacy state (pre-ADR-012, no persisted codec) was ALWAYS the
+        lagrange codec — defaulting it to leopard would silently change
+        parity bytes against the chain's own committed roots."""
+        from celestia_tpu.ops import gf256 as _gf256
+
+        raw = self.store.store("meta").get(b"codec")
+        self.codec = raw.decode() if raw else _gf256.CODEC_LAGRANGE
+        _gf256.set_active_codec(self.codec)
 
     @classmethod
     def import_genesis(cls, dump: dict, **kwargs) -> "App":
@@ -895,6 +921,12 @@ class App:
         app.store = MultiStore.import_state(dump["state"])
         for name in STORE_NAMES:
             app.store.ensure_store(name)
+        app._restore_codec_from_meta()
+        if "codec" in dump:  # explicit dump key wins (they should agree)
+            from celestia_tpu.ops import gf256 as _gf256
+
+            app.codec = dump["codec"]
+            _gf256.set_active_codec(app.codec)
         app._wire_keepers()
         app.genesis_time_ns = dump.get("genesis_time_ns", 0)
         app.store.commit(1)
@@ -922,6 +954,7 @@ class App:
         app.store = MultiStore.import_state(state)
         for name in STORE_NAMES:
             app.store.ensure_store(name)
+        app._restore_codec_from_meta()
         app._wire_keepers()
         app.genesis_time_ns = genesis_time_ns
         got = app.store.app_hash()
@@ -955,6 +988,7 @@ class App:
         raw_cid = meta.get(b"chain_id")
         if raw_cid:
             app.chain_id = raw_cid.decode()
+        app._restore_codec_from_meta()
         app._wire_keepers()
         got = app.store.app_hash()
         if got != expected_app_hash:

@@ -71,6 +71,14 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.extend_block_cpu.argtypes = [
         u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p, u8p, u8p,
     ]
+    lib.gf_load_mul.argtypes = [u8p]
+    lib.leo_encode.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
+    lib.leo_extend_square_cpu.argtypes = [
+        u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.extend_block_leopard_cpu.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p, u8p, u8p,
+    ]
     lib.secp256k1_ecmul_double.argtypes = [u8p, u8p, u8p, u8p, u8p]
     lib.secp256k1_ecmul_double.restype = ctypes.c_int
     lib.secp256k1_ecmul_double_batch.argtypes = [
@@ -97,6 +105,23 @@ def _ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
 
+_loaded_codec: Optional[str] = None
+
+
+def _ensure_field(lib) -> None:
+    """Keep the native MUL table in the active codec's representation so
+    table-method GF legs here stay bit-identical to the device path."""
+    global _loaded_codec
+    from celestia_tpu.ops import gf256
+
+    codec = gf256.active_codec()
+    if codec == _loaded_codec:
+        return
+    table = np.ascontiguousarray(gf256.mul_table(codec))
+    lib.gf_load_mul(_ptr(table))
+    _loaded_codec = codec
+
+
 def rs_extend_square(square: np.ndarray) -> np.ndarray:
     """uint8[k, k, B] -> uint8[2k, 2k, B] (bit-identical to the device)."""
     from celestia_tpu.ops.gf256 import encode_matrix
@@ -104,6 +129,7 @@ def rs_extend_square(square: np.ndarray) -> np.ndarray:
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
+    _ensure_field(lib)
     square = np.ascontiguousarray(square, dtype=np.uint8)
     k, B = square.shape[0], square.shape[2]
     E = np.ascontiguousarray(encode_matrix(k))
@@ -147,6 +173,7 @@ def extend_block_cpu(square: np.ndarray, nthreads: int = 0):
         raise RuntimeError("native library unavailable")
     from celestia_tpu.ops.gf256 import encode_matrix
 
+    _ensure_field(lib)
     square = np.ascontiguousarray(square, dtype=np.uint8)
     k, B = square.shape[0], square.shape[2]
     E = np.ascontiguousarray(encode_matrix(k))
@@ -155,6 +182,53 @@ def extend_block_cpu(square: np.ndarray, nthreads: int = 0):
     data_root = np.zeros(32, dtype=np.uint8)
     lib.extend_block_cpu(
         _ptr(square), _ptr(E), k, B, nthreads, _ptr(eds), _ptr(roots),
+        _ptr(data_root),
+    )
+    return eds, roots, data_root
+
+
+def leo_encode(data: np.ndarray) -> np.ndarray:
+    """Leopard FFT encode of one axis: data uint8[k, B] -> parity
+    uint8[k, B] (O(k log k); codec-independent — always the leopard
+    code, used for cross-validation and the bench leg)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    k, B = data.shape
+    parity = np.zeros((k, B), dtype=np.uint8)
+    lib.leo_encode(_ptr(data), k, B, _ptr(parity))
+    return parity
+
+
+def leo_extend_square(square: np.ndarray, nthreads: int = 0) -> np.ndarray:
+    """Leopard-codec square extension (FFT per axis): uint8[k, k, B] ->
+    uint8[2k, 2k, B], quadrant layout as rs_extend_square."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    square = np.ascontiguousarray(square, dtype=np.uint8)
+    k, B = square.shape[0], square.shape[2]
+    eds = np.zeros((2 * k, 2 * k, B), dtype=np.uint8)
+    lib.leo_extend_square_cpu(_ptr(square), _ptr(eds), k, B, nthreads)
+    return eds
+
+
+def extend_block_leopard_cpu(square: np.ndarray, nthreads: int = 0):
+    """Full CPU ExtendBlock via the Leopard O(n log n) FFT codec:
+    square -> (eds, axis roots, data root).  The honest vs_leopard_cpu
+    comparison leg for bench.py (the reference's codec class at full
+    size, same SHA/NMT stage as extend_block_cpu)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    square = np.ascontiguousarray(square, dtype=np.uint8)
+    k, B = square.shape[0], square.shape[2]
+    eds = np.zeros((2 * k, 2 * k, B), dtype=np.uint8)
+    roots = np.zeros((4 * k, 90), dtype=np.uint8)
+    data_root = np.zeros(32, dtype=np.uint8)
+    lib.extend_block_leopard_cpu(
+        _ptr(square), k, B, nthreads, _ptr(eds), _ptr(roots),
         _ptr(data_root),
     )
     return eds, roots, data_root
@@ -202,6 +276,7 @@ def gf_matmul_axes(D: np.ndarray, X: np.ndarray, nthreads: int = 0) -> np.ndarra
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
+    _ensure_field(lib)
     D = np.ascontiguousarray(D, dtype=np.uint8)
     X = np.ascontiguousarray(X, dtype=np.uint8)
     n, R, k = D.shape

@@ -15,6 +15,9 @@
 
 #include <cstdint>
 #include <cstring>
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
 
 #include <thread>
 #include <vector>
@@ -46,6 +49,15 @@ void gf_init(void) {
         for (int b = 0; b < 256; b++)
             MUL[a][b] = gf_mul_slow((uint8_t)a, (uint8_t)b);
     gf_ready = 1;
+}
+
+// Override the multiplication table with a caller-supplied 256x256 one —
+// the Python side loads the active codec's field representation (the
+// leopard codec works in the Cantor-index domain, gf256.mul_table) so
+// every table-method leg here computes in the same field as the device.
+void gf_load_mul(const uint8_t* table) {
+    memcpy(MUL, table, 256 * 256);
+    gf_ready = 1;  // later gf_init() calls must not clobber the load
 }
 
 // parity[i][b] ^= MUL[E[i][j]][data[j][b]] for a row of k shares of B bytes.
@@ -360,6 +372,60 @@ static void rfc6962_root_pow2_cpu(const uint8_t* leaves, int n, int leaf_len,
     delete[] lvl;
 }
 
+// Thread-striping helper shared by the CPU pipelines.
+static void run_striped(void (*fn)(void*, int, int), void* ctx, int count,
+                        int nthreads) {
+    int nt = nthreads < count ? nthreads : count;
+    if (nt <= 1) {
+        fn(ctx, 0, 1);
+        return;
+    }
+    std::vector<std::thread> ts;
+    for (int t = 0; t < nt; t++) ts.emplace_back(fn, ctx, t, nt);
+    for (auto& th : ts) th.join();
+}
+
+struct RootsCtx {
+    const uint8_t* eds;
+    uint8_t* roots;
+    int k, B, n;
+    size_t row_bytes;
+};
+
+// 4k NMT axis roots (rows then cols) + RFC-6962 data root of an EDS —
+// the post-extension stage shared by the table-method and leopard legs.
+static void eds_roots_threaded(const uint8_t* eds, int k, int B,
+                               int nthreads, uint8_t* roots,
+                               uint8_t* data_root) {
+    const int n = 2 * k;
+    RootsCtx ctx = {eds, roots, k, B, n, (size_t)n * B};
+    run_striped(
+        [](void* p, int t, int nt) {
+            RootsCtx& c = *(RootsCtx*)p;
+            const int leaf_len = NS + c.B;
+            uint8_t* leaves = new uint8_t[(size_t)c.n * leaf_len];
+            for (int a = t; a < 2 * c.n; a += nt) {
+                const int is_col = a >= c.n;
+                const int idx = is_col ? a - c.n : a;
+                for (int j = 0; j < c.n; j++) {
+                    const int r = is_col ? j : idx;
+                    const int col = is_col ? idx : j;
+                    const uint8_t* cell =
+                        c.eds + ((size_t)r * c.n + col) * c.B;
+                    uint8_t* leaf = leaves + (size_t)j * leaf_len;
+                    if (r < c.k && col < c.k) memcpy(leaf, cell, NS);
+                    else memset(leaf, 0xFF, NS);
+                    memcpy(leaf + NS, cell, c.B);
+                }
+                nmt_root(leaves, c.n, leaf_len,
+                         c.roots + (size_t)a * DIGEST);
+            }
+            delete[] leaves;
+        },
+        &ctx, 2 * n, nthreads);
+    rfc6962_root_pow2_cpu(roots, 2 * n, DIGEST, data_root);
+}
+
 // Full ExtendBlock on the CPU: square k*k*B -> EDS 2k*2k*B, 4k NMT axis
 // roots (4k x 90) and the RFC-6962 data root (32 bytes), using nthreads
 // worker threads (0 = hardware concurrency).
@@ -374,14 +440,7 @@ void extend_block_cpu(const uint8_t* square, const uint8_t* E, int k, int B,
     const int n = 2 * k;
     const size_t row_bytes = (size_t)n * B;
     auto run = [&](void (*fn)(void*, int, int), void* ctx, int count) {
-        int nt = nthreads < count ? nthreads : count;
-        if (nt <= 1) {
-            fn(ctx, 0, 1);
-            return;
-        }
-        std::vector<std::thread> ts;
-        for (int t = 0; t < nt; t++) ts.emplace_back(fn, ctx, t, nt);
-        for (auto& th : ts) th.join();
+        run_striped(fn, ctx, count, nthreads);
     };
     struct Ctx {
         const uint8_t* square;
@@ -424,30 +483,229 @@ void extend_block_cpu(const uint8_t* square, const uint8_t* E, int k, int B,
             delete[] par;
         },
         &ctx, n);
-    // 4k NMT axis roots, striped (rows then cols; axis index a in [0, 2n))
-    run(
+    // 4k NMT axis roots + data root (shared post-extension stage)
+    eds_roots_threaded(eds, k, B, nthreads, roots, data_root);
+}
+
+// ---------------------------------------------------------------------------
+// Leopard-compatible O(n log n) codec: the LCH novel-basis FFT over
+// GF(2^8)/0x11D with the catid/leopard Cantor basis, high-rate layout
+// (parity at positions [0, k), data at [k, 2k)).  This is the reference
+// chain's erasure code (rsmt2d.NewLeoRSCodec ->
+// klauspost/reedsolomon's leopard FF8 port; selected at
+// /root/reference/pkg/appconsts/global_consts.go:91-92).  Field elements
+// are represented in the Cantor-index domain exactly as leopard's tables
+// do (see celestia_tpu/ops/gf256.py "codec selection"); correctness is
+// pinned by tests/test_leopard_codec.py: this FFT must agree
+// byte-for-byte with the independent Lagrange-matrix construction.
+// Role here: the honest CPU comparison leg for bench.py (vs_leopard_cpu)
+// and a fast host encode for the leopard codec.
+// ---------------------------------------------------------------------------
+
+static uint8_t LEO_MUL_TAB[256][256];
+static uint8_t LEO_SKEW[8][256];  // SKEW[j][x] = W_j(x) / W_j(2^j) in F'
+static int leo_ready = 0;
+
+static void leo_init(void) {
+    if (leo_ready) return;
+    // standard log/exp over 0x11D (LFSR), then remap through the Cantor
+    // index bijection C so multiplication is leopard's conjugated form
+    uint8_t lg[256] = {0};
+    uint8_t ex[255];
+    int x = 1;
+    for (int i = 0; i < 255; i++) {
+        ex[i] = (uint8_t)x;
+        lg[x] = (uint8_t)i;
+        x <<= 1;
+        if (x & 0x100) x ^= 0x11D;
+    }
+    static const uint8_t basis[8] = {1, 214, 152, 146, 86, 200, 88, 230};
+    uint8_t C[256];
+    C[0] = 0;
+    for (int j = 0; j < 8; j++) {
+        int w = 1 << j;
+        for (int i = 0; i < w; i++) C[w + i] = C[i] ^ basis[j];
+    }
+    uint8_t Cinv[256];
+    for (int i = 0; i < 256; i++) Cinv[C[i]] = (uint8_t)i;
+    uint8_t leo_log[256] = {0};
+    uint8_t leo_exp[255];
+    for (int v = 1; v < 256; v++) leo_log[v] = lg[C[v]];
+    for (int e = 0; e < 255; e++) leo_exp[e] = Cinv[ex[e]];
+    for (int a = 0; a < 256; a++)
+        for (int b = 0; b < 256; b++)
+            LEO_MUL_TAB[a][b] =
+                (a && b) ? leo_exp[(leo_log[a] + leo_log[b]) % 255] : 0;
+    // subspace vanishing polynomials: W_0(x) = x,
+    // W_{j+1}(x) = W_j(x) * W_j(x ^ 2^j)  (evaluated over all 256 points)
+    uint8_t W[256];
+    for (int xv = 0; xv < 256; xv++) W[xv] = (uint8_t)xv;
+    for (int j = 0; j < 8; j++) {
+        const uint8_t wj = W[1 << j];  // W_j(v_j) != 0 (v_j not in V_j)
+        const uint8_t inv = leo_exp[(255 - leo_log[wj]) % 255];
+        for (int xv = 0; xv < 256; xv++)
+            LEO_SKEW[j][xv] = LEO_MUL_TAB[W[xv]][inv];
+        if (j < 7) {
+            uint8_t Wn[256];
+            for (int xv = 0; xv < 256; xv++)
+                Wn[xv] = LEO_MUL_TAB[W[xv]][W[xv ^ (1 << j)]];
+            memcpy(W, Wn, 256);
+        }
+    }
+    leo_ready = 1;
+}
+
+static inline void leo_mul_add(uint8_t* x, const uint8_t* y, uint8_t c,
+                               int B) {
+    if (c == 0) return;
+    const uint8_t* row = LEO_MUL_TAB[c];
+#if defined(__AVX2__)
+    // pshufb 4-bit-split constant multiply — the same kernel shape real
+    // Leopard uses, so the bench leg is an honest SIMD comparison:
+    // y = ylo ^ (yhi << 4), mul(c, y) = LO[ylo] ^ HI[yhi] by linearity
+    // of GF multiplication over XOR.
+    if (B >= 32) {
+        uint8_t lot[16], hit[16];
+        for (int v = 0; v < 16; v++) {
+            lot[v] = row[v];
+            hit[v] = row[v << 4];
+        }
+        const __m256i lo =
+            _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)lot));
+        const __m256i hi =
+            _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)hit));
+        const __m256i mask = _mm256_set1_epi8(0x0F);
+        int b = 0;
+        for (; b + 32 <= B; b += 32) {
+            __m256i yv = _mm256_loadu_si256((const __m256i*)(y + b));
+            __m256i pl = _mm256_shuffle_epi8(lo, _mm256_and_si256(yv, mask));
+            __m256i ph = _mm256_shuffle_epi8(
+                hi, _mm256_and_si256(_mm256_srli_epi16(yv, 4), mask));
+            __m256i xv = _mm256_loadu_si256((const __m256i*)(x + b));
+            _mm256_storeu_si256(
+                (__m256i*)(x + b),
+                _mm256_xor_si256(xv, _mm256_xor_si256(pl, ph)));
+        }
+        for (; b < B; b++) x[b] ^= row[y[b]];
+        return;
+    }
+#endif
+    for (int b = 0; b < B; b++) x[b] ^= row[y[b]];
+}
+
+static inline void leo_xor_blk(uint8_t* x, const uint8_t* y, int B) {
+    for (int b = 0; b < B; b++) x[b] ^= y[b];
+}
+
+// FFT: novel-basis coefficients -> evaluations at coset ^ [0, n).
+// Butterfly (a, b) -> (a + s*b, a + (s+1)*b) with s the coset skew; the
+// paired point differs by v_j, and W_j(x + v_j)/W_j(v_j) = s + 1 because
+// W_j is GF(2)-linearized.
+static void leo_fft(uint8_t* work, int n, int coset, int B) {
+    for (int dist = n >> 1, j = 0; dist >= 1; dist >>= 1) {
+        for (j = 0; (1 << j) < dist; j++) {}
+        for (int b0 = 0; b0 < n; b0 += 2 * dist) {
+            const uint8_t skew = LEO_SKEW[j][coset ^ b0];
+            for (int i = b0; i < b0 + dist; i++) {
+                uint8_t* a = work + (size_t)i * B;
+                uint8_t* b = work + (size_t)(i + dist) * B;
+                leo_mul_add(a, b, skew, B);  // a += s*b
+                leo_xor_blk(b, a, B);        // b  = a_old + (s+1)*b_old
+            }
+        }
+    }
+}
+
+// exact inverse of leo_fft (same skews, reversed order + inverted
+// butterfly: b' = a ^ b recovers the f1 half, then a ^= s*b')
+static void leo_ifft(uint8_t* work, int n, int coset, int B) {
+    for (int dist = 1; dist < n; dist <<= 1) {
+        int j = 0;
+        for (j = 0; (1 << j) < dist; j++) {}
+        for (int b0 = 0; b0 < n; b0 += 2 * dist) {
+            const uint8_t skew = LEO_SKEW[j][coset ^ b0];
+            for (int i = b0; i < b0 + dist; i++) {
+                uint8_t* a = work + (size_t)i * B;
+                uint8_t* b = work + (size_t)(i + dist) * B;
+                leo_xor_blk(b, a, B);
+                leo_mul_add(a, b, skew, B);
+            }
+        }
+    }
+}
+
+// One axis: k data shards (B bytes each) -> k parity shards.  High-rate
+// m = k (k a power of two): recover the interpolating polynomial's
+// novel-basis coefficients from the data coset (offset k), then evaluate
+// at the parity coset (offset 0).  O(k log k) block operations.
+void leo_encode(const uint8_t* data, int k, int B, uint8_t* parity) {
+    leo_init();
+    memcpy(parity, data, (size_t)k * B);
+    leo_ifft(parity, k, k, B);
+    leo_fft(parity, k, 0, B);
+}
+
+// Leopard-codec square extension (quadrant layout as rs_extend_square).
+void leo_extend_square_cpu(const uint8_t* square, uint8_t* eds, int k, int B,
+                           int nthreads) {
+    leo_init();
+    if (nthreads <= 0) {
+        nthreads = (int)std::thread::hardware_concurrency();
+        if (nthreads <= 0) nthreads = 1;
+    }
+    const int n = 2 * k;
+    const size_t row_bytes = (size_t)n * B;
+    struct Ctx {
+        const uint8_t* square;
+        uint8_t* eds;
+        int k, B, n;
+        size_t row_bytes;
+    } ctx = {square, eds, k, B, n, row_bytes};
+    // Q0 + Q1 per original row
+    run_striped(
         [](void* p, int t, int nt) {
             Ctx& c = *(Ctx*)p;
-            const int leaf_len = NS + c.B;
-            uint8_t* leaves = new uint8_t[(size_t)c.n * leaf_len];
-            for (int a = t; a < 2 * c.n; a += nt) {
-                const int is_col = a >= c.n;
-                const int idx = is_col ? a - c.n : a;
-                for (int j = 0; j < c.n; j++) {
-                    const int r = is_col ? j : idx;
-                    const int col = is_col ? idx : j;
-                    const uint8_t* cell = c.eds + ((size_t)r * c.n + col) * c.B;
-                    uint8_t* leaf = leaves + (size_t)j * leaf_len;
-                    if (r < c.k && col < c.k) memcpy(leaf, cell, NS);
-                    else memset(leaf, 0xFF, NS);
-                    memcpy(leaf + NS, cell, c.B);
-                }
-                nmt_root(leaves, c.n, leaf_len, c.roots + (size_t)a * DIGEST);
+            for (int r = t; r < c.k; r += nt) {
+                memcpy(c.eds + r * c.row_bytes,
+                       c.square + (size_t)r * c.k * c.B, (size_t)c.k * c.B);
+                leo_encode(c.eds + r * c.row_bytes, c.k, c.B,
+                           c.eds + r * c.row_bytes + (size_t)c.k * c.B);
             }
-            delete[] leaves;
         },
-        &ctx, 2 * n);
-    rfc6962_root_pow2_cpu(roots, 2 * n, DIGEST, data_root);
+        &ctx, k, nthreads);
+    // Q2/Q3 per column (gather, encode, scatter)
+    run_striped(
+        [](void* p, int t, int nt) {
+            Ctx& c = *(Ctx*)p;
+            uint8_t* col = new uint8_t[(size_t)c.k * c.B];
+            uint8_t* par = new uint8_t[(size_t)c.k * c.B];
+            for (int cc = t; cc < c.n; cc += nt) {
+                for (int r = 0; r < c.k; r++)
+                    memcpy(col + (size_t)r * c.B,
+                           c.eds + r * c.row_bytes + (size_t)cc * c.B, c.B);
+                leo_encode(col, c.k, c.B, par);
+                for (int r = 0; r < c.k; r++)
+                    memcpy(c.eds + (size_t)(c.k + r) * c.row_bytes +
+                               (size_t)cc * c.B,
+                           par + (size_t)r * c.B, c.B);
+            }
+            delete[] col;
+            delete[] par;
+        },
+        &ctx, n, nthreads);
+}
+
+// Full leopard-codec ExtendBlock: the O(n log n) FFT extension + the same
+// threaded NMT/data-root stage — the honest vs_leopard_cpu bench leg.
+void extend_block_leopard_cpu(const uint8_t* square, int k, int B,
+                              int nthreads, uint8_t* eds, uint8_t* roots,
+                              uint8_t* data_root) {
+    if (nthreads <= 0) {
+        nthreads = (int)std::thread::hardware_concurrency();
+        if (nthreads <= 0) nthreads = 1;
+    }
+    leo_extend_square_cpu(square, eds, k, B, nthreads);
+    eds_roots_threaded(eds, k, B, nthreads, roots, data_root);
 }
 
 // ---------------------------------------------------------------------------

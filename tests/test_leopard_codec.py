@@ -1,0 +1,229 @@
+"""Leopard-compatible share codec (ADR-012, VERDICT r4 missing #1).
+
+The reference chain's erasure code is Leopard FF8 (rsmt2d.NewLeoRSCodec
+at /root/reference/pkg/appconsts/global_consts.go:91-92, backed by
+klauspost/reedsolomon's port of catid/leopard).  A systematic MDS RS
+code's parity bytes are uniquely determined by the field, the evaluation
+points, and the data/parity layout — independent of the encode
+algorithm — so this repo reproduces Leopard's parity on the MXU by
+using the Cantor-index field representation and Leopard's high-rate
+layout in the SAME bit-matmul pipeline (celestia_tpu/ops/gf256.py).
+
+Validation layers (each pair independently derived):
+
+1. the Cantor basis constants satisfy their defining recurrence
+   (beta_0 = 1, beta_i^2 + beta_i = beta_{i-1}, lexicographically
+   smaller root) and are GF(2)-independent;
+2. the F'-native Lagrange construction == explicit conjugation through
+   the standard field;
+3. the native C++ LCH FFT (O(n log n), skew tables — the algorithm
+   leopard actually runs) == the Lagrange matrix, for every square size;
+4. device bit-matmul == native table leg == FFT leg on random squares;
+5. host and device repair round-trip under the leopard codec;
+6. the constant-share Go golden vectors are codec-independent (their
+   parity equals the data under any MDS code), so they still pin the
+   layout/hash machinery; a NON-constant random square pins the
+   leopard parity bytes themselves (and demonstrably differs from the
+   lagrange codec's bytes);
+7. the codec is pinned at genesis and survives export/import.
+"""
+
+import numpy as np
+import pytest
+
+from celestia_tpu.ops import gf256, rs
+from celestia_tpu.utils import native
+
+
+@pytest.fixture(autouse=True)
+def _leopard_codec():
+    """These tests assume the default (leopard) codec; restore whatever
+    was active afterwards so test order cannot leak codec state."""
+    prev = gf256.active_codec()
+    gf256.set_active_codec(gf256.CODEC_LEOPARD)
+    yield
+    gf256.set_active_codec(prev)
+
+
+def test_cantor_basis_derivation():
+    """beta_0 = 1; each beta_i is the lexicographically SMALLER root of
+    x^2 + x = beta_{i-1} in GF(2^8)/0x11D; the 8 vectors span the field."""
+    basis = gf256.CANTOR_BASIS
+    assert basis[0] == 1
+    for i in range(1, 8):
+        roots = [
+            x
+            for x in range(256)
+            if int(gf256.gf_mul(x, x, gf256.CODEC_LAGRANGE)) ^ x
+            == basis[i - 1]
+        ]
+        assert basis[i] == min(roots), (
+            f"beta_{i}={basis[i]} is not the smaller root of "
+            f"x^2+x={basis[i - 1]} (roots: {roots})"
+        )
+    span = set()
+    for idx in range(256):
+        x = 0
+        for j in range(8):
+            if idx >> j & 1:
+                x ^= basis[j]
+        span.add(x)
+    assert len(span) == 256, "Cantor basis is not GF(2)-independent"
+
+
+def test_field_conjugation_consistency():
+    """F'-native Lagrange parity == explicit conjugation through the
+    standard field (two independently derived computations)."""
+    C = np.zeros(256, dtype=np.uint8)
+    for j, b in enumerate(gf256.CANTOR_BASIS):
+        w = 1 << j
+        C[w : 2 * w] = C[:w] ^ b
+    Cinv = np.zeros(256, dtype=np.uint8)
+    Cinv[C] = np.arange(256, dtype=np.uint8)
+    rng = np.random.default_rng(0)
+    for k in (2, 4, 16):
+        d = rng.integers(0, 256, (k, 7), dtype=np.uint8)
+        p1 = gf256.encode_shares_ref(d, codec=gf256.CODEC_LEOPARD)
+        src = C[np.arange(k, 2 * k)]
+        dst = C[np.arange(k)]
+        L = gf256.lagrange_matrix(src, dst, codec=gf256.CODEC_LAGRANGE)
+        mapped = C[d]
+        out = np.zeros_like(mapped)
+        for j in range(k):
+            out ^= gf256.gf_mul(
+                L[:, j : j + 1], mapped[j : j + 1, :], gf256.CODEC_LAGRANGE
+            )
+        assert np.array_equal(p1, Cinv[out]), f"k={k}"
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_fft_matches_lagrange_matrix_all_sizes():
+    """The C++ LCH FFT encode (the O(n log n) algorithm leopard runs)
+    agrees byte-for-byte with the Lagrange-matrix construction at every
+    protocol square size — two independent derivations of the code."""
+    rng = np.random.default_rng(42)
+    for k in (1, 2, 4, 8, 16, 32, 64, 128):
+        d = rng.integers(0, 256, (k, 16), dtype=np.uint8)
+        p_fft = native.leo_encode(d)
+        p_mat = gf256.encode_shares_ref(d, codec=gf256.CODEC_LEOPARD)
+        assert np.array_equal(p_fft, p_mat), f"k={k}"
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_device_native_fft_pipelines_agree():
+    """Device bit-matmul EDS == native table EDS == FFT EDS."""
+    rng = np.random.default_rng(7)
+    for k in (2, 8):
+        sq = rng.integers(0, 256, (k, k, 64), dtype=np.uint8)
+        eds_dev = np.asarray(rs.extend_square(sq))
+        eds_nat = native.rs_extend_square(sq)
+        eds_fft = native.leo_extend_square(sq, nthreads=1)
+        assert np.array_equal(eds_dev, eds_nat), f"k={k}"
+        assert np.array_equal(eds_dev, eds_fft), f"k={k}"
+
+
+def test_repair_round_trip_under_leopard():
+    rng = np.random.default_rng(9)
+    k = 8
+    sq = rng.integers(0, 256, (k, k, 64), dtype=np.uint8)
+    eds = np.asarray(rs.extend_square(sq))
+    avail = rng.random((2 * k, 2 * k)) >= 0.25
+    damaged = eds.copy()
+    damaged[~avail] = 0
+    assert np.array_equal(rs.repair_square(damaged, avail), eds)
+    assert np.array_equal(
+        np.asarray(rs.repair_square_device(damaged, avail)), eds
+    )
+
+
+# Self-generated regression anchors over a deterministic random 16x16
+# square (seed 20260731): unlike the constant-share Go fixtures these pin
+# the PARITY BYTES, and the two codecs provably differ on them.  The
+# leopard value is the expected data root of the reference chain for this
+# square (modulo the Go cross-check, which needs a Go toolchain).
+LEO_16_DAH = bytes.fromhex(
+    "e20c2e42ab8a807ca8b3b3414bc90251cf82f95e80f3d437e603af9792314127"
+)
+LAG_16_DAH = bytes.fromhex(
+    "a5e15795f7d53d9368ffce460432e4cca3ad5f14acf3d91b9102a6c12e12e861"
+)
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_non_constant_square_vectors_pin_parity():
+    from celestia_tpu.da.dah import DataAvailabilityHeader
+
+    k = 16
+    sq = np.random.default_rng(20260731).integers(
+        0, 256, (k, k, 512), dtype=np.uint8
+    )
+    got = {}
+    for codec in (gf256.CODEC_LEOPARD, gf256.CODEC_LAGRANGE):
+        gf256.set_active_codec(codec)
+        _, roots, _ = native.extend_block_cpu(sq, nthreads=1)
+        rows = tuple(roots[i].tobytes() for i in range(2 * k))
+        cols = tuple(roots[i].tobytes() for i in range(2 * k, 4 * k))
+        got[codec] = DataAvailabilityHeader.compute_hash(rows, cols)
+    assert got[gf256.CODEC_LEOPARD] == LEO_16_DAH
+    assert got[gf256.CODEC_LAGRANGE] == LAG_16_DAH
+
+
+def test_constant_shares_are_codec_independent():
+    """The Go golden fixtures use one repeated share; interpolating k
+    equal values gives a constant polynomial, so parity == data under
+    BOTH codecs — which is exactly why those vectors pin the layout and
+    hashing but not the codec."""
+    const = np.full((8, 16), 0xAB, dtype=np.uint8)
+    for codec in gf256.CODECS:
+        assert np.array_equal(
+            gf256.encode_shares_ref(const, codec=codec), const
+        )
+
+
+def test_codec_pinned_at_genesis_and_survives_export():
+    from celestia_tpu.state.app import App
+
+    app = App(chain_id="codec-test-1")
+    app.init_chain({"chain_id": "codec-test-1", "codec": gf256.CODEC_LAGRANGE})
+    assert gf256.active_codec() == gf256.CODEC_LAGRANGE
+    assert app.codec == gf256.CODEC_LAGRANGE
+    dump = app.export_genesis()
+    assert dump["codec"] == gf256.CODEC_LAGRANGE
+    gf256.set_active_codec(gf256.CODEC_LEOPARD)
+    app2 = App.import_genesis(dump)
+    assert gf256.active_codec() == gf256.CODEC_LAGRANGE
+    assert app2.codec == gf256.CODEC_LAGRANGE
+    with pytest.raises(ValueError):
+        App(chain_id="bad").init_chain({"codec": "no-such-codec"})
+
+
+def test_legacy_state_restores_lagrange():
+    """Persisted state WITHOUT a codec key (pre-ADR-012) must restore
+    under lagrange — the codec it was created with — not the new
+    default, or its own committed data roots would become unverifiable."""
+    from celestia_tpu.state.app import App
+
+    app = App(chain_id="legacy-1")
+    app.init_chain({"chain_id": "legacy-1", "codec": gf256.CODEC_LAGRANGE})
+    dump = app.export_genesis()
+    # simulate a pre-ADR-012 dump: strip every persisted codec marker
+    dump.pop("codec")
+    dump["state"]["meta"].pop(b"codec".hex(), None)
+    dump["state"]["meta"].pop(b"codec", None)
+    gf256.set_active_codec(gf256.CODEC_LEOPARD)
+    app2 = App.import_genesis(dump)
+    assert app2.codec == gf256.CODEC_LAGRANGE
+    assert gf256.active_codec() == gf256.CODEC_LAGRANGE
+
+
+def test_position_point_layout():
+    """Leopard high-rate layout: parity occupies points [0, k), data
+    [k, 2k) — position -> point is XOR with k."""
+    k = 8
+    pos = np.arange(2 * k)
+    pts = gf256.position_points(pos, k, gf256.CODEC_LEOPARD)
+    assert list(pts[:k]) == list(range(k, 2 * k))  # data positions
+    assert list(pts[k:]) == list(range(k))  # parity positions
+    assert list(
+        gf256.position_points(pos, k, gf256.CODEC_LAGRANGE)
+    ) == list(pos)

@@ -76,32 +76,14 @@ def create_commitment(
     blob: Blob, subtree_root_threshold: int = DEFAULT_SUBTREE_ROOT_THRESHOLD
 ) -> bytes:
     """32-byte share commitment of a blob."""
-    import hashlib
-
     from celestia_tpu.da.shares import blob_shares_array
 
-    key = (
-        hashlib.sha256(
-            blob.namespace.raw + blob.share_version.to_bytes(1, "big")
-            + blob.data
-        ).digest(),
-        subtree_root_threshold,
-    )
+    key = _commitment_key(blob, subtree_root_threshold)
     cached = _COMMITMENT_CACHE.get(key)
     if cached is not None:
         return cached
 
-    arr = blob_shares_array(blob.namespace, blob.data, blob.share_version)
-    n = arr.shape[0]
-    width = subtree_width(n, subtree_root_threshold)
-    sizes = merkle_mountain_range_sizes(n, width)
-    # NMT leaves: namespace-prefixed shares (Q0 rule — own namespace).
-    ns = np.broadcast_to(
-        np.frombuffer(blob.namespace.raw, dtype=np.uint8), (n, NAMESPACE_SIZE)
-    )
-    leaves = np.ascontiguousarray(
-        np.concatenate([ns, arr], axis=1)
-    )  # (n, 541)
+    leaves, sizes = _blob_leaves(blob, subtree_root_threshold)
     if native.available():
         # one native call per blob (subtree roots + RFC-6962 fold inside)
         out = native.create_commitment(leaves, sizes)
@@ -120,3 +102,81 @@ def create_commitment(
 
 def create_commitments(blobs: List[Blob]) -> List[bytes]:
     return [create_commitment(b) for b in blobs]
+
+
+def _commitment_key(blob: Blob, subtree_root_threshold: int):
+    import hashlib
+
+    return (
+        hashlib.sha256(
+            blob.namespace.raw + (blob.share_version & 0xFF).to_bytes(1, "big")
+            + blob.data
+        ).digest(),
+        subtree_root_threshold,
+    )
+
+
+def _blob_leaves(blob: Blob, subtree_root_threshold: int):
+    """(ns-prefixed NMT leaves uint8[n, 541], mountain sizes) for one
+    blob — the single construction shared by create_commitment and
+    warm_commitments (a consensus value must not have two layouts)."""
+    from celestia_tpu.da.shares import blob_shares_array
+
+    arr = blob_shares_array(blob.namespace, blob.data, blob.share_version)
+    n = arr.shape[0]
+    width = subtree_width(n, subtree_root_threshold)
+    sizes = merkle_mountain_range_sizes(n, width)
+    # NMT leaves: namespace-prefixed shares (Q0 rule — own namespace).
+    ns = np.broadcast_to(
+        np.frombuffer(blob.namespace.raw, dtype=np.uint8), (n, NAMESPACE_SIZE)
+    )
+    leaves = np.ascontiguousarray(np.concatenate([ns, arr], axis=1))
+    return leaves, sizes
+
+
+def warm_commitments(
+    blobs: List[Blob],
+    subtree_root_threshold: int = DEFAULT_SUBTREE_ROOT_THRESHOLD,
+) -> None:
+    """Precompute commitments for MANY blobs in ONE native call and fill
+    the cache, so the per-blob ``create_commitment`` calls inside tx
+    validation all hit.  At proposal scale the per-blob ctypes crossing
+    was a visible slice of FilterTxs (512 blobs x ~27 us call overhead);
+    the batch shape also lets the C side thread across blobs.  No-op for
+    blobs already cached; falls back to nothing (the per-blob path
+    handles it) when the native library is absent.  Malformed blobs are
+    skipped (best-effort): callers pass unvalidated envelopes, and the
+    per-tx validate_blob_tx path reports them."""
+    if not native.available():
+        return
+
+    pending: List[tuple] = []  # (key, leaves, sizes)
+    seen = set()
+    for blob in blobs:
+        try:
+            key = _commitment_key(blob, subtree_root_threshold)
+            if key in seen or key in _COMMITMENT_CACHE:
+                continue
+            seen.add(key)
+            leaves, sizes = _blob_leaves(blob, subtree_root_threshold)
+        except (ValueError, OverflowError):
+            # warming is best-effort over UNVALIDATED blobs: a malformed
+            # one (empty data, bad share version) is simply skipped here
+            # and reported properly by the per-tx validate_blob_tx path
+            continue
+        pending.append((key, leaves, sizes))
+    if not pending:
+        return
+    leaves_all = np.ascontiguousarray(
+        np.concatenate([p[1] for p in pending], axis=0)
+    )
+    blob_off = np.cumsum([0] + [p[1].shape[0] for p in pending])
+    sizes_all = np.concatenate([p[2] for p in pending]).astype(np.int32)
+    size_off = np.cumsum([0] + [len(p[2]) for p in pending])
+    out = native.create_commitments_batch(
+        leaves_all, blob_off, sizes_all, size_off
+    )
+    for i, (key, _, _) in enumerate(pending):
+        while len(_COMMITMENT_CACHE) >= _COMMITMENT_CACHE_MAX:
+            _COMMITMENT_CACHE.pop(next(iter(_COMMITMENT_CACHE)))
+        _COMMITMENT_CACHE[key] = out[i].tobytes()

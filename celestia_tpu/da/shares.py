@@ -250,6 +250,13 @@ def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
         pos += 1
         result |= (b & 0x7F) << shift
         if not b & 0x80:
+            # minimal-encoding rule (specs/wire.md "Primitives"): a
+            # multi-byte varint must not end in a zero group — without
+            # this, the same value has many encodings and a signed tx's
+            # wire bytes become malleable (sign_bytes covers the
+            # verbatim wire slices, SignDoc parity)
+            if b == 0 and shift > 0:
+                raise ValueError("non-minimal varint encoding")
             if result >= 1 << 64:
                 raise ValueError("varint exceeds uint64 range")
             return result, pos

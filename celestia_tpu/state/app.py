@@ -383,15 +383,32 @@ class App:
         # for blob txs).
         decoded: List[tuple] = []
         tx_keys: List[bytes] = []
+        # pass 1: unmarshal envelopes and batch-warm every blob commitment
+        # in ONE native call (per-blob recompute inside validate_blob_tx
+        # then hits the cache) — at proposal scale the per-blob native
+        # crossings were a visible slice of FilterTxs
+        parsed: List[tuple] = []  # (raw, key, btx_or_None, cache_hit)
+        warm: List = []
         for raw in txs:
             key = _hashlib.sha256(raw).digest()
             tx_keys.append(key)
             hit = self._decoded_cache.get(key)
             if hit is not None:
                 self._decoded_cache.move_to_end(key)
-                decoded.append((raw, hit[0], hit[1], None))
+                parsed.append((raw, key, None, hit))
                 continue
             btx = unmarshal_blob_tx(raw)
+            if btx is not None:
+                warm.extend(btx.blobs)
+            parsed.append((raw, key, btx, None))
+        if warm:
+            from celestia_tpu.da.inclusion import warm_commitments
+
+            warm_commitments(warm)
+        for raw, key, btx, hit in parsed:
+            if hit is not None:
+                decoded.append((raw, hit[0], hit[1], None))
+                continue
             try:
                 if btx is not None:
                     # full BlobTx validation incl. commitment recompute

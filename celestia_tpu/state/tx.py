@@ -776,8 +776,15 @@ class Tx:
             return cached[1]
         out = bytearray()
         _put_bytes(out, chain_id.encode())
-        _put_bytes(out, self.body_bytes())
-        _put_bytes(out, self.auth_bytes())
+        # decoded txs carry their verbatim wire slices (unmarshal_tx);
+        # locally-built txs serialize fresh — identical bytes either way
+        # because the wire is canonical (minimal varints enforced by
+        # _read_varint), and the raw slices are what the signature
+        # actually covers (SignDoc parity)
+        body = getattr(self, "_wire_body", None)
+        auth = getattr(self, "_wire_auth", None)
+        _put_bytes(out, body if body is not None else self.body_bytes())
+        _put_bytes(out, auth if auth is not None else self.auth_bytes())
         digest = hashlib.sha256(bytes(out)).digest()
         object.__setattr__(self, "_sign_bytes_memo", (chain_id, digest))
         return digest
@@ -853,7 +860,14 @@ def unmarshal_tx(raw: bytes) -> Tx:
     fee_granter, apos = _get_bytes(auth, apos)
     if apos != len(auth):
         raise ValueError("trailing bytes in tx auth")
-    return Tx(
+    tx = Tx(
         tuple(msgs), Fee(fee_amount, gas_limit), pubkey, sequence,
         account_number, memo_b.decode(), sig, timeout_height, fee_granter,
     )
+    # stash the verbatim wire slices: sign_bytes hashes THESE instead of
+    # re-serializing (SignDoc semantics — the reference signs over the
+    # raw BodyBytes/AuthInfoBytes from the wire, and re-encoding 512
+    # proposal txs was a visible slice of FilterTxs host time)
+    object.__setattr__(tx, "_wire_body", body)
+    object.__setattr__(tx, "_wire_auth", auth)
+    return tx

@@ -63,6 +63,14 @@ def _load() -> Optional[ctypes.CDLL]:
         u8p, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int, u8p,
     ]
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    try:
+        lib.create_commitments_batch.argtypes = [
+            u8p, ctypes.c_int, i32p, i32p, i32p, ctypes.c_int, u8p,
+            ctypes.c_int,
+        ]
+    except AttributeError:
+        return None  # stale .so predating this round: see codec guard below
     lib.eds_nmt_roots.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
     lib.gf_matmul_axes.argtypes = [
         u8p, u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -71,14 +79,21 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.extend_block_cpu.argtypes = [
         u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p, u8p, u8p,
     ]
-    lib.gf_load_mul.argtypes = [u8p]
-    lib.leo_encode.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
-    lib.leo_extend_square_cpu.argtypes = [
-        u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-    ]
-    lib.extend_block_leopard_cpu.argtypes = [
-        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p, u8p, u8p,
-    ]
+    try:
+        lib.gf_load_mul.argtypes = [u8p]
+        lib.leo_encode.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
+        lib.leo_extend_square_cpu.argtypes = [
+            u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.extend_block_leopard_cpu.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p, u8p, u8p,
+        ]
+    except AttributeError:
+        # stale .so without the codec symbols: the GF legs would compute
+        # in the WRONG field for the leopard codec (gf_load_mul missing),
+        # so the lib is unusable as a coherent unit — degrade to the
+        # pure-Python/device paths entirely rather than risk wrong parity
+        return None
     lib.secp256k1_ecmul_double.argtypes = [u8p, u8p, u8p, u8p, u8p]
     lib.secp256k1_ecmul_double.restype = ctypes.c_int
     lib.secp256k1_ecmul_double_batch.argtypes = [
@@ -268,6 +283,32 @@ def create_commitment(leaves: np.ndarray, sizes) -> bytes:
         len(sizes_arr), _ptr(out),
     )
     return out.tobytes()
+
+
+def create_commitments_batch(
+    leaves: np.ndarray, blob_off: np.ndarray, sizes: np.ndarray,
+    size_off: np.ndarray, nthreads: int = 0,
+) -> np.ndarray:
+    """Commitments for MANY blobs in one call: leaves uint8[total, leaf_len]
+    (all blobs' ns-prefixed shares concatenated), blob_off int32[n+1] row
+    offsets, sizes int32[...] mountain widths (concatenated), size_off
+    int32[n+1] offsets into sizes.  Returns uint8[n, 32]."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    leaves = np.ascontiguousarray(leaves, dtype=np.uint8)
+    blob_off = np.ascontiguousarray(blob_off, dtype=np.int32)
+    sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+    size_off = np.ascontiguousarray(size_off, dtype=np.int32)
+    n = len(blob_off) - 1
+    out = np.zeros((n, 32), dtype=np.uint8)
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    lib.create_commitments_batch(
+        _ptr(leaves), leaves.shape[1],
+        blob_off.ctypes.data_as(i32), sizes.ctypes.data_as(i32),
+        size_off.ctypes.data_as(i32), n, _ptr(out), nthreads,
+    )
+    return out
 
 
 def gf_matmul_axes(D: np.ndarray, X: np.ndarray, nthreads: int = 0) -> np.ndarray:

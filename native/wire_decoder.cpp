@@ -102,7 +102,9 @@ struct Reader {
     Reader(const std::vector<uint8_t>& v) : p(v.data()), n(v.size()) {}
     Reader(const uint8_t* data, size_t len) : p(data), n(len) {}
 
-    // unsigned LEB128, bounded to uint64 (spec "Primitives")
+    // unsigned LEB128, bounded to uint64, MINIMAL encoding required
+    // (spec "Primitives"): a multi-byte varint must not end in a zero
+    // group, or the same value has many wire forms (malleability)
     uint64_t varint() {
         uint64_t out = 0;
         int shift = 0;
@@ -110,7 +112,11 @@ struct Reader {
             if (pos >= n) throw std::runtime_error("truncated varint");
             uint8_t b = p[pos++];
             out |= (uint64_t)(b & 0x7F) << shift;
-            if (!(b & 0x80)) return out;
+            if (!(b & 0x80)) {
+                if (b == 0 && shift > 0)
+                    throw std::runtime_error("non-minimal varint");
+                return out;
+            }
             shift += 7;
             if (shift > 63) throw std::runtime_error("varint too long");
         }

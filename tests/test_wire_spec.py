@@ -74,6 +74,40 @@ def test_cpp_decodes_signed_tx(decoder):
     assert got["signature"] == tx.signature.hex()
 
 
+def test_non_minimal_varint_rejected():
+    """Canonical wire (specs/wire.md Primitives): 0x80 0x00 decodes to 0
+    under lax LEB128 but MUST be rejected — sign_bytes covers the
+    verbatim wire slices, so a second encoding of the same value would
+    make signed txs malleable."""
+    from celestia_tpu.da.shares import _read_varint
+
+    assert _read_varint(b"\x00", 0) == (0, 1)
+    assert _read_varint(b"\x80\x01", 0) == (128, 2)
+    for bad in (b"\x80\x00", b"\xff\x00", b"\x80\x80\x00"):
+        with pytest.raises(ValueError):
+            _read_varint(bad, 0)
+
+
+def test_cpp_rejects_non_minimal_varint(decoder):
+    """The C++ decoder enforces the same canonical rule from the spec
+    alone: a tx whose leading varint is padded must fail to decode."""
+    key, msg, tx = _signed_send_tx()
+    raw = tx.marshal()
+    # re-encode the leading length varint of the body field non-minimally
+    from celestia_tpu.da.shares import _read_varint
+
+    length, pos = _read_varint(raw, 0)
+    padded = bytes([raw[0] | 0x80, 0x00]) if raw[0] < 0x80 else None
+    if padded is None:
+        pytest.skip("leading varint already multi-byte")
+    tampered = padded + raw[pos:]
+    out = subprocess.run(
+        [str(BIN), "tx"], input=tampered.hex(), capture_output=True,
+        text=True, timeout=30,
+    )
+    assert out.returncode != 0
+
+
 def test_cpp_decodes_utf8_memo(decoder):
     """Non-ASCII memos must survive the C++ leg byte-identically: the
     Python encoder writes memos as UTF-8 (state/tx.py Tx.marshal), so the

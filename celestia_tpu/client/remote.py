@@ -194,6 +194,13 @@ class RemoteNode:
         out = self._call_json("TxPush", {"txs": [r.hex() for r in raws]})
         return int(out.get("admitted", 0))
 
+    def peer_exchange(self, sender: str, peers) -> list:
+        """PEX: offer our address + known peers, learn the callee's."""
+        out = self._call_json(
+            "PeerExchange", {"sender": sender, "peers": list(peers)}
+        )
+        return list(out.get("peers", []))
+
     def wait_for_height(self, h: int, timeout_s: float = 60.0) -> None:
         deadline = time.time() + timeout_s
         while self.height < h:

@@ -5,14 +5,20 @@ transaction admission between validator processes, driven by node-local
 wall-clock timers — no central relay in the critical path.  Each
 validator process runs one :class:`GossipEngine`:
 
-- **Consensus flood.**  The engine drains its own BFT engine's outbox
-  and floods every message to its peers; a received message is delivered
-  to the local engine once (dedup by locally-computed content hash —
-  never by a sender-supplied id, which a malicious relayer could use to
-  poison the dedup set and censor real messages) and re-flooded to the
-  other peers.  With N validators the mesh is fully connected here
-  (production meshes sparsify; flood+dedup is the correctness core
-  either way).
+- **Consensus flood with BOUNDED fanout.**  The engine drains its own
+  BFT engine's outbox and floods every message to at most ``fanout``
+  randomly-sampled peers (default min(N-1, 8)); a received message is
+  delivered to the local engine once (dedup by locally-computed content
+  hash — never by a sender-supplied id, which a malicious relayer could
+  use to poison the dedup set and censor real messages) and re-flooded
+  onward, so coverage comes from multi-hop epidemic spread rather than
+  O(N²) direct links.  Round timeouts + the status-poll catch-up are
+  the liveness backstop for the (rare) sampling gaps.
+- **Peer exchange (PEX).**  ``--peers`` needs only one seed: engines
+  periodically swap peer lists with a random peer (the comet
+  p2p/addrbook role, /root/reference/cmd/celestia-appd/cmd/root.go:141),
+  merging new addresses up to ``max_peers``.  Killing the seed after
+  bootstrap does not affect the mesh.
 - **Per-peer sender threads.**  Every peer gets its own outbound queue
   and worker; a hung or black-holed peer blocks only its own link,
   never the pump loop or the round timers.
@@ -44,6 +50,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import random as _random
 import threading
 import time as _time
 from collections import OrderedDict, deque
@@ -160,7 +167,10 @@ class _PeerLink:
                     continue
             cli = self._ensure_client()
             if cli is None:
-                continue  # peer down; the item is dropped (flood re-sends)
+                # peer down; the item is dropped (flood re-sends) and the
+                # failure counts toward PEX-learned-address eviction
+                self.engine._peer_failed(self.addr)
+                continue
             try:
                 if kind == "msg":
                     cli.gossip_msg(data)
@@ -171,8 +181,15 @@ class _PeerLink:
                         cli.tx_push(
                             [by_hash[h] for h in want if h in by_hash]
                         )
+                elif kind == "pex":
+                    learned = cli.peer_exchange(
+                        self.engine._self_name(), data
+                    )
+                    self.engine._merge_peers([self.addr] + list(learned))
+                self.engine._peer_ok(self.addr)
             except Exception:
                 self._drop_client()
+                self.engine._peer_failed(self.addr)
 
 
 class GossipEngine:
@@ -192,11 +209,27 @@ class GossipEngine:
         block_gap_s: float = 0.0,
         client_timeout_s: float = 5.0,
         reannounce_s: float = 2.0,
+        fanout: int = 8,
+        max_peers: int = 64,
+        pex_interval_s: float = 1.0,
         logger=None,
     ):
         self.node = node
         self.log = logger if logger is not None else _log
-        self.peer_addrs = list(peer_addrs)
+        self.peer_addrs = list(dict.fromkeys(peer_addrs))
+        # operator-configured addresses are never evicted; PEX-learned
+        # ones are dropped after repeated delivery failures so a poisoned
+        # address book drains instead of eclipsing honest peers forever
+        self._static_peers = set(self.peer_addrs)
+        self._peer_failures: Dict[str, int] = {}
+        self._evict_after = 5
+        self.fanout = max(1, fanout)
+        self.max_peers = max_peers
+        self.pex_interval_s = pex_interval_s
+        self._last_pex = 0.0
+        self._pex_rr = 0  # round-robin cursor over peers for PEX
+        self._catch_up_thread: Optional[threading.Thread] = None
+        self._pull_backoff: Dict[str, float] = {}
         self.tick_s = tick_s
         self.base_timeout_s = base_timeout_s
         self.timeout_delta_s = timeout_delta_s
@@ -232,11 +265,85 @@ class GossipEngine:
                 self._links[addr] = link
             return link
 
+    def _peers_snapshot(self, exclude: Optional[str] = None) -> List[str]:
+        with self._lock:
+            return [a for a in self.peer_addrs if a != exclude]
+
+    # at most this many NEW addresses are admitted per PEX exchange, so
+    # one malicious reply cannot fill the whole book in a single swap
+    _PEX_BATCH_LIMIT = 8
+
+    @staticmethod
+    def _normalize_addr(addr: str) -> Optional[str]:
+        """Canonical dialable form, or None for junk: rejects wildcard
+        binds (0.0.0.0 / ::) that would make a peer dial itself, and
+        folds the localhost alias so the self-filter can't be bypassed
+        by spelling."""
+        if not isinstance(addr, str) or len(addr) > 128 or ":" not in addr:
+            return None
+        host, _, port = addr.rpartition(":")
+        if not port.isdigit():
+            return None
+        if host in ("0.0.0.0", "::", "[::]", ""):
+            return None
+        if host == "localhost":
+            host = "127.0.0.1"
+        return f"{host}:{port}"
+
+    def _merge_peers(self, addrs) -> None:
+        """Admit newly-learned peer addresses (PEX): normalized, bounded
+        per exchange (_PEX_BATCH_LIMIT) and in total (max_peers); dead
+        entries are evicted by _peer_failed, so garbage costs bounded
+        slots for a bounded time, not permanent book space."""
+        me = self._self_name()
+        admitted = 0
+        with self._lock:
+            known = set(self.peer_addrs)
+            for addr in addrs:
+                addr = self._normalize_addr(addr)
+                if addr is None or addr == me or addr in known:
+                    continue
+                if (
+                    len(self.peer_addrs) >= self.max_peers
+                    or admitted >= self._PEX_BATCH_LIMIT
+                ):
+                    break
+                self.peer_addrs.append(addr)
+                known.add(addr)
+                admitted += 1
+
+    def _peer_ok(self, addr: str) -> None:
+        with self._lock:
+            self._peer_failures.pop(addr, None)
+
+    def _peer_failed(self, addr: str) -> None:
+        """Called by a peer's link worker after a failed delivery.  A
+        PEX-learned address that keeps failing is evicted (its link
+        worker winds down on its own); operator-configured seeds are
+        kept — the flood keeps retrying them."""
+        with self._lock:
+            n = self._peer_failures.get(addr, 0) + 1
+            self._peer_failures[addr] = n
+            if addr in self._static_peers or n < self._evict_after:
+                return
+            if addr in self.peer_addrs:
+                self.peer_addrs.remove(addr)
+            self._peer_failures.pop(addr, None)
+            link = self._links.pop(addr, None)
+        if link is not None:
+            link._stop.set()  # worker exits on its own; never join here
+            link._event.set()
+            self.log.warn("evicted unresponsive PEX-learned peer", peer=addr)
+
     def _flood(self, wire: dict, exclude: Optional[str] = None) -> None:
         payload = {"wire": wire, "sender": self._self_name()}
-        for addr in self.peer_addrs:
-            if exclude is not None and addr == exclude:
-                continue
+        peers = self._peers_snapshot(exclude)
+        if len(peers) > self.fanout:
+            # epidemic spread: each hop re-floods to its own sample, so
+            # a random subset per message covers the mesh w.h.p. while
+            # links stay O(N * fanout) instead of O(N^2)
+            peers = _random.sample(peers, self.fanout)
+        for addr in peers:
             self._link(addr).send("msg", payload)
 
     # -- inbound RPC surface (called from server threads) ---------------
@@ -308,6 +415,12 @@ class GossipEngine:
         self._flood(wire, exclude=sender)
         return True
 
+    def on_peer_exchange(self, sender: str, peers: List[str]) -> List[str]:
+        """PEX inbound: learn the sender + its peers, return our list so
+        the exchange is symmetric.  Called from gRPC server threads."""
+        self._merge_peers([sender] + list(peers))
+        return self._peers_snapshot()
+
     def on_tx_have(self, hashes: List[bytes]) -> List[bytes]:
         """want/have: return the subset of announced tx hashes this node
         does not hold."""
@@ -360,6 +473,9 @@ class GossipEngine:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        t = self._catch_up_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
         for link in self._links.values():
             link.stop()
         self._links.clear()
@@ -414,8 +530,21 @@ class GossipEngine:
                     self._timers.append((due, *key))
         # 4. announce pooled txs (fresh every tick; full pool periodically)
         self._announce_txs(now)
-        # 5. catch-up pull when traffic shows we're behind
-        self._catch_up()
+        # 5. catch-up pull when traffic shows we're behind — on its OWN
+        # thread: peer addresses can be PEX-learned (untrusted), and a
+        # book full of black holes must never stall the pump loop whose
+        # first job is firing the round timers
+        self._maybe_catch_up()
+        # 6. PEX: swap peer lists with one peer (round-robin) per
+        # interval — the exchange runs on that peer's link worker, so a
+        # dead peer can never stall the pump or the round timers
+        if now - self._last_pex >= self.pex_interval_s:
+            self._last_pex = now
+            peers = self._peers_snapshot()
+            if peers:
+                target = peers[self._pex_rr % len(peers)]
+                self._pex_rr += 1
+                self._link(target).send("pex", peers)
 
     def _self_name(self) -> str:
         return getattr(self.node, "_server_address", "") or "peer"
@@ -437,7 +566,13 @@ class GossipEngine:
             return
         hashes = [h for h, _ in batch]
         by_hash = dict(batch)
-        for addr in self.peer_addrs:
+        peers = self._peers_snapshot()
+        if len(peers) > self.fanout:
+            # receivers re-announce admitted txs and the periodic full
+            # re-announce rotates samples, so fanout-bounded want/have
+            # still reaches everyone
+            peers = _random.sample(peers, self.fanout)
+        for addr in peers:
             self._link(addr).send("announce", (hashes, by_hash))
 
     def _pull_client(self, addr: str):
@@ -460,16 +595,9 @@ class GossipEngine:
             except Exception:
                 pass
 
-    def _catch_up(self) -> None:
-        """Pull decided blocks we're missing.  Runs in the pump thread
-        with direct (blocking) RPCs — only active when behind, and the
-        timers already fired this tick.
-
-        The wire-derived hint only TRIGGERS the check; the pull target
-        is the peers' actually-reported best height (rate-limited status
-        poll), so a Byzantine validator signing sky-high vote heights
-        cannot lock the mesh into a permanent catch-up loop — a hint no
-        reachable peer corroborates is discarded."""
+    def _maybe_catch_up(self) -> None:
+        """Spawn at most one background catch-up worker when behind.
+        The pump thread never blocks on a peer RPC."""
         now = _time.time()
         with self._lock:
             behind = self._behind_hint
@@ -477,27 +605,56 @@ class GossipEngine:
             return
         if now - self._last_status_poll < 0.5:
             return
+        t = self._catch_up_thread
+        if t is not None and t.is_alive():
+            return
         self._last_status_poll = now
+        t = threading.Thread(
+            target=self._catch_up, name="gossip-catchup", daemon=True
+        )
+        self._catch_up_thread = t
+        t.start()
+
+    def _catch_up(self) -> None:
+        """Pull decided blocks we're missing (background worker, direct
+        blocking RPCs).  Unreachable peers get a cooldown so a poisoned
+        address book costs each poll a bounded set of dial attempts.
+
+        The wire-derived hint only TRIGGERS the check; the pull target
+        is the peers' actually-reported best height (rate-limited status
+        poll), so a Byzantine validator signing sky-high vote heights
+        cannot lock the mesh into a permanent catch-up loop — a hint no
+        reachable peer corroborates is discarded."""
+        now = _time.time()
         best = 0
-        for addr in self.peer_addrs:
+        with self._lock:
+            backoff = dict(self._pull_backoff)
+        peers = [
+            a for a in self._peers_snapshot() if backoff.get(a, 0.0) <= now
+        ]
+        for addr in peers:
             cli = self._pull_client(addr)
             if cli is None:
+                self._pull_backoff[addr] = _time.time() + 10.0
                 continue
             try:
                 best = max(best, int(cli.status().get("height", 0)))
+                self._pull_backoff.pop(addr, None)
             except Exception:
                 self._drop_pull_client(addr)
+                self._pull_backoff[addr] = _time.time() + 10.0
         if best <= self.node.height:
             with self._lock:
                 # nobody is actually ahead: the hint was noise
                 self._behind_hint = self.node.height
             return
         target = best
-        for addr in self.peer_addrs:
+        for addr in peers:
             if self.node.height >= target:
                 return
             cli = self._pull_client(addr)
             if cli is None:
+                self._pull_backoff[addr] = _time.time() + 10.0
                 continue
             try:
                 while self.node.height < target:
@@ -508,3 +665,4 @@ class GossipEngine:
                         break
             except Exception:
                 self._drop_pull_client(addr)
+                self._pull_backoff[addr] = _time.time() + 10.0

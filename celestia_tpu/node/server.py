@@ -219,6 +219,18 @@ class NodeService:
         want = eng.on_tx_have(hashes) if eng is not None else []
         return json.dumps({"want": [h.hex() for h in want]}).encode()
 
+    def peer_exchange(self, req: bytes, ctx) -> bytes:
+        """PEX (comet p2p/addrbook role): learn the caller + its peers,
+        return ours."""
+        d = json.loads(req)
+        eng = getattr(self.node, "gossip_engine", None)
+        if eng is None:
+            return json.dumps({"peers": []}).encode()
+        peers = eng.on_peer_exchange(
+            str(d.get("sender", "")), list(d.get("peers", []))
+        )
+        return json.dumps({"peers": peers}).encode()
+
     def tx_push(self, req: bytes, ctx) -> bytes:
         d = json.loads(req)
         eng = getattr(self.node, "gossip_engine", None)
@@ -258,6 +270,7 @@ class NodeService:
             "GossipMsg": self.gossip_msg,
             "TxHave": self.tx_have,
             "TxPush": self.tx_push,
+            "PeerExchange": self.peer_exchange,
         }
         method_handlers = {
             name: grpc.unary_unary_rpc_method_handler(

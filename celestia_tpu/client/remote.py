@@ -201,6 +201,18 @@ class RemoteNode:
         )
         return list(out.get("peers", []))
 
+    # -- state-sync (snapshot serving) ----------------------------------
+
+    def snapshot_list(self) -> list:
+        """Snapshot metadata dicts the peer can serve (state-sync)."""
+        return list(self._call_json("SnapshotList", {}).get("snapshots", []))
+
+    def snapshot_chunk(self, height: int, fmt: int, idx: int):
+        out = self._call_json(
+            "SnapshotChunk", {"height": height, "format": fmt, "idx": idx}
+        )
+        return bytes.fromhex(out["data"]) if out.get("found") else None
+
     def wait_for_height(self, h: int, timeout_s: float = 60.0) -> None:
         deadline = time.time() + timeout_s
         while self.height < h:

@@ -340,6 +340,46 @@ def validate_payload_against_chain(
     )
 
 
+def verify_commit_certificate(
+    chain_id: str,
+    validators: Dict[bytes, int],
+    pubkeys: Dict[bytes, bytes],
+    total_power: int,
+    payload: "BlockPayload",
+    precommits: List["Vote"],
+) -> Tuple[bool, str]:
+    """Standalone 2/3 commit-certificate check over one block id: the
+    verification core of adopt_decision, callable WITHOUT an engine and
+    with no side effects — state-sync verifies a snapshot's anchoring
+    certificate with this before swapping any state in."""
+    h = payload.height
+    bid = payload.block_id
+    rounds = {v.round for v in precommits}
+    if len(rounds) != 1:
+        return False, "certificate mixes rounds"
+    seen: Set[bytes] = set()
+    power = 0
+    for v in precommits:
+        if v.round < 0:
+            return False, "negative round in certificate"
+        if v.vtype != PRECOMMIT or v.height != h or v.block_id != bid:
+            return False, "certificate vote does not match the block"
+        if v.validator in seen:
+            return False, "duplicate validator in certificate"
+        seen.add(v.validator)
+        vp = validators.get(v.validator)
+        pk_raw = pubkeys.get(v.validator)
+        if not vp or pk_raw is None:
+            return False, "unknown validator in certificate"
+        digest = vote_sign_bytes(chain_id, v.height, v.round, v.vtype, v.block_id)
+        if not PublicKey.from_compressed(pk_raw).verify(digest, v.signature):
+            return False, "certificate signature invalid"
+        power += vp
+    if power * 3 < total_power * 2:
+        return False, "certificate below 2/3 power"
+    return True, ""
+
+
 def last_commit_vote_pairs(
     validators: Dict[bytes, int], payload: BlockPayload
 ) -> List[Tuple[bytes, bool]]:
@@ -484,41 +524,23 @@ class BFTNode:
         h = payload.height
         if h in self.decided:
             return True, "already decided"
-        bid = payload.block_id
-        rounds = {v.round for v in precommits}
-        if len(rounds) != 1:
-            return False, "certificate mixes rounds"
-        seen: Set[bytes] = set()
-        power = 0
-        for v in precommits:
-            if v.round < 0:
-                return False, "negative round in certificate"
-            if v.vtype != PRECOMMIT or v.height != h or v.block_id != bid:
-                return False, "certificate vote does not match the block"
-            if v.validator in seen:
-                return False, "duplicate validator in certificate"
-            seen.add(v.validator)
-            vp = self.validators.get(v.validator)
-            pk_raw = self.pubkeys.get(v.validator)
-            if not vp or pk_raw is None:
-                return False, "unknown validator in certificate"
-            digest = vote_sign_bytes(
-                self.chain_id, v.height, v.round, v.vtype, v.block_id
-            )
-            if not PublicKey.from_compressed(pk_raw).verify(
-                digest, v.signature
-            ):
-                return False, "certificate signature invalid"
-            power += vp
-        if not self._quorum(power):
-            return False, "certificate below 2/3 power"
+        ok, why = verify_commit_certificate(
+            self.chain_id, self.validators, self.pubkeys,
+            self.total_power, payload, precommits,
+        )
+        if not ok:
+            return False, why
         self.height = max(self.height, h)
-        self._payloads[bid] = payload
-        decided = DecidedBlock(payload, next(iter(rounds)), list(precommits))
+        self._payloads[payload.block_id] = payload
+        # the helper guaranteed a non-empty single-round certificate
+        decided = DecidedBlock(payload, precommits[0].round, list(precommits))
         self.decided[h] = decided
         if self.on_decide:
             self.on_decide(decided)
         return True, ""
+
+    # (verify_commit_certificate lives at module level so state-sync can
+    # verify a snapshot's anchoring certificate BEFORE any state swap)
 
     def _start_round(self, round_: int) -> None:
         if self.height in self.decided:

@@ -660,9 +660,80 @@ class GossipEngine:
                 while self.node.height < target:
                     d = cli.bft_decided(self.node.height + 1)
                     if d is None:
-                        break
+                        # the peer has pruned past our height: a node
+                        # offline longer than the decided-log window
+                        # state-syncs from a served snapshot, then
+                        # resumes certificate replay from there
+                        if not self._try_state_sync(cli):
+                            break
+                        continue
                     if not self.node.bft_catchup(d)[0]:
                         break
             except Exception:
                 self._drop_pull_client(addr)
                 self._pull_backoff[addr] = _time.time() + 10.0
+
+    def _try_state_sync(self, cli) -> bool:
+        """Network state-sync (VERDICT r4 #4; the reference serves
+        snapshots to syncing peers, root.go:227-243 +
+        default_overrides.go:296-297).  Trust order matters: the
+        anchoring certificate (decided block at snapshot height + 1,
+        2/3-signed, committing to the snapshot's app hash via
+        prev_app_hash) is verified BEFORE any chunk is applied — a
+        malicious snapshot can never swap state in."""
+        from celestia_tpu.node.snapshots import SnapshotStore
+
+        try:
+            metas = cli.snapshot_list()
+        except Exception:
+            return False
+        metas = [
+            m for m in metas if int(m.get("height", 0)) > self.node.height
+        ]
+        for meta in sorted(metas, key=lambda m: -int(m["height"])):
+            try:
+                anchor = cli.bft_decided(int(meta["height"]) + 1)
+                if anchor is None:
+                    continue
+                ok, why = self.node.verify_state_sync_anchor(meta, anchor)
+                if not ok:
+                    self.log.warn(
+                        "state-sync snapshot rejected", reason=why,
+                        height=meta.get("height"),
+                    )
+                    continue
+                n_chunks = int(meta["chunks"])
+                # the chunk COUNT is peer-supplied and not covered by the
+                # anchor certificate: bound it so one peer cannot force
+                # unbounded download/memory per sync attempt (1 MiB
+                # chunks -> 512 MiB cap, far above any real app state)
+                if n_chunks > 512 or len(meta.get("chunk_hashes", [])) != (
+                    n_chunks
+                ):
+                    raise ValueError(
+                        f"implausible snapshot shape: {n_chunks} chunks"
+                    )
+                chunks = []
+                for i in range(n_chunks):
+                    c = cli.snapshot_chunk(
+                        int(meta["height"]), int(meta.get("format", 1)), i
+                    )
+                    if c is None:
+                        raise ValueError(f"peer missing chunk {i}")
+                    if hashlib.sha256(c).hexdigest() != meta["chunk_hashes"][i]:
+                        # abort on FIRST corrupt chunk, not after the
+                        # whole download
+                        raise ValueError(f"chunk {i} corrupt in transfer")
+                    chunks.append(c)
+                data = SnapshotStore.assemble(meta, chunks)
+                self.node.adopt_state_sync(meta, data)
+                self.node.bft_catchup(anchor)  # apply the anchor block
+                self.log.warn(
+                    "state-synced from peer snapshot",
+                    height=meta["height"],
+                )
+                return True
+            except Exception as e:
+                self.log.warn("state-sync attempt failed", err=str(e)[:200])
+                continue
+        return False

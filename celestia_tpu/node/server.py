@@ -219,6 +219,26 @@ class NodeService:
         want = eng.on_tx_have(hashes) if eng is not None else []
         return json.dumps({"want": [h.hex() for h in want]}).encode()
 
+    def snapshot_list(self, req: bytes, ctx) -> bytes:
+        """State-sync serving (root.go:227-243 role): metadata of the
+        snapshots this node can serve, incl. per-chunk hashes."""
+        store = getattr(self.node, "snapshots", None)
+        metas = store.list_wire() if store is not None else []
+        return json.dumps({"snapshots": metas}).encode()
+
+    def snapshot_chunk(self, req: bytes, ctx) -> bytes:
+        d = json.loads(req)
+        store = getattr(self.node, "snapshots", None)
+        chunk = None
+        if store is not None:
+            chunk = store.chunk_bytes(
+                int(d["height"]), int(d.get("format", 1)), int(d["idx"])
+            )
+        return json.dumps(
+            {"found": chunk is not None,
+             "data": chunk.hex() if chunk is not None else ""}
+        ).encode()
+
     def peer_exchange(self, req: bytes, ctx) -> bytes:
         """PEX (comet p2p/addrbook role): learn the caller + its peers,
         return ours."""
@@ -271,6 +291,8 @@ class NodeService:
             "TxHave": self.tx_have,
             "TxPush": self.tx_push,
             "PeerExchange": self.peer_exchange,
+            "SnapshotList": self.snapshot_list,
+            "SnapshotChunk": self.snapshot_chunk,
         }
         method_handlers = {
             name: grpc.unary_unary_rpc_method_handler(

@@ -101,24 +101,29 @@ class SnapshotStore:
 
     # -- listing / pruning ---------------------------------------------
 
-    def list(self) -> List[SnapshotInfo]:
+    def _iter_metas(self) -> List[dict]:
+        """All snapshot metadata dicts on disk, sorted by height — the
+        single directory walk behind list() and list_wire()."""
         out = []
         for d in sorted(self.root.iterdir()):
             meta = d / "metadata.json"
             if not d.is_dir() or not meta.exists():
                 continue
-            m = json.loads(meta.read_text())
-            out.append(
-                SnapshotInfo(
-                    height=m["height"],
-                    format=m["format"],
-                    chunks=m["chunks"],
-                    app_hash=bytes.fromhex(m["app_hash"]),
-                    chain_id=m["chain_id"],
-                    app_version=m["app_version"],
-                )
+            out.append(json.loads(meta.read_text()))
+        return sorted(out, key=lambda m: m["height"])
+
+    def list(self) -> List[SnapshotInfo]:
+        return [
+            SnapshotInfo(
+                height=m["height"],
+                format=m["format"],
+                chunks=m["chunks"],
+                app_hash=bytes.fromhex(m["app_hash"]),
+                chain_id=m["chain_id"],
+                app_version=m["app_version"],
             )
-        return sorted(out, key=lambda s: s.height)
+            for m in self._iter_metas()
+        ]
 
     def prune(self, keep_recent: int) -> int:
         snaps = self.list()
@@ -138,17 +143,39 @@ class SnapshotStore:
         """Read + verify chunks; returns {"state":…, "genesis_time_ns":…}."""
         d = self.root / info.dirname
         meta = json.loads((d / "metadata.json").read_text())
-        payload = b""
-        for i in range(info.chunks):
-            chunk = (d / f"chunk-{i:04d}").read_bytes()
-            want = meta["chunk_hashes"][i]
+        chunks = [
+            (d / f"chunk-{i:04d}").read_bytes() for i in range(info.chunks)
+        ]
+        return self.assemble(meta, chunks)
+
+    # -- network serving (state-sync over gRPC) ------------------------
+
+    def list_wire(self) -> List[dict]:
+        """Snapshot metadata as JSON-safe dicts (incl. chunk hashes) for
+        the SnapshotList RPC."""
+        return self._iter_metas()
+
+    def chunk_bytes(self, height: int, fmt: int, idx: int) -> Optional[bytes]:
+        """One verified-on-write chunk, or None when absent."""
+        d = self.root / f"{height}-{fmt}"
+        path = d / f"chunk-{idx:04d}"
+        if not path.exists():
+            return None
+        return path.read_bytes()
+
+    @staticmethod
+    def assemble(meta: dict, chunks: List[bytes]) -> dict:
+        """Verify fetched chunks against the metadata hashes and decode
+        the state payload — the restore half of the wire protocol.  The
+        hashes only catch transfer corruption; TRUST comes from the app
+        hash + commit-certificate checks done by the caller."""
+        if len(chunks) != meta["chunks"]:
+            raise ValueError("chunk count mismatch")
+        for i, chunk in enumerate(chunks):
             got = hashlib.sha256(chunk).hexdigest()
-            if got != want:
-                raise ValueError(
-                    f"snapshot chunk {i} corrupt: sha256 {got} != {want}"
-                )
-            payload += chunk
-        return json.loads(zlib.decompress(payload))
+            if got != meta["chunk_hashes"][i]:
+                raise ValueError(f"snapshot chunk {i} corrupt in transfer")
+        return json.loads(zlib.decompress(b"".join(chunks)))
 
     def restore_app(self, info: SnapshotInfo, **app_kwargs):
         """Build a fresh App from a snapshot; verifies the app hash."""

@@ -246,6 +246,7 @@ class TestNode:
                 f"this node's validator key ({own.hex()}) is not in the "
                 "BFT valset — check priv_validator_key.json vs valset.json"
             )
+        self._bft_valset = [dict(v) for v in valset]  # for state-sync re-arm
         self._bft_block_ids: Dict[int, bytes] = {}
         self._bft_decided_log: Dict[int, dict] = {}
         self._bft = BFTNode(
@@ -336,7 +337,8 @@ class TestNode:
             "payload": payload.to_wire(),
             "precommits": [v.to_wire() for v in decided.precommits],
         }
-        while len(self._bft_decided_log) > 512:
+        log_max = getattr(self, "bft_decided_log_max", 512)
+        while len(self._bft_decided_log) > log_max:
             self._bft_decided_log.pop(next(iter(self._bft_decided_log)))
         # identical LastCommitInfo everywhere: derived from the payload's
         # certificate over the SORTED valset, never from local votes
@@ -406,6 +408,78 @@ class TestNode:
                 Vote.from_wire(v) for v in decided_wire["precommits"]
             ]
             return self._bft.adopt_decision(payload, precommits)
+
+    def verify_state_sync_anchor(
+        self, meta: dict, decided_wire: dict
+    ) -> Tuple[bool, str]:
+        """Pre-swap trust check for network state-sync: the decided block
+        at meta.height+1 must carry a valid 2/3 commit certificate (over
+        this node's valset) AND its prev_app_hash must equal the
+        snapshot's app hash — only then is the snapshot state certified
+        by the validator set, not merely self-consistent."""
+        from celestia_tpu.node.bft import (
+            BlockPayload,
+            Vote,
+            verify_commit_certificate,
+        )
+
+        with self._service_lock:
+            if self._bft is None:
+                return False, "BFT mode not enabled"
+            if meta.get("chain_id") != self.chain_id:
+                return False, "snapshot is for a different chain"
+            if int(meta["height"]) <= self.height:
+                return False, "snapshot is not ahead of this node"
+            payload = BlockPayload.from_wire(decided_wire["payload"])
+            if payload.height != int(meta["height"]) + 1:
+                return False, "anchor block is not snapshot height + 1"
+            if payload.prev_app_hash != bytes.fromhex(meta["app_hash"]):
+                return False, (
+                    "anchor certificate does not commit to the snapshot's "
+                    "app hash"
+                )
+            precommits = [
+                Vote.from_wire(v) for v in decided_wire["precommits"]
+            ]
+            return verify_commit_certificate(
+                self._bft.chain_id, self._bft.validators,
+                self._bft.pubkeys, self._bft.total_power, payload,
+                precommits,
+            )
+
+    def adopt_state_sync(self, meta: dict, data: dict) -> None:
+        """Swap in a snapshot state fetched from the network (AFTER
+        verify_state_sync_anchor passed).  The app is rebuilt from the
+        chunk payload (restore_from_snapshot re-verifies that the state
+        reproduces the recorded app hash), block bookkeeping resets to
+        the snapshot height, and the BFT engine is re-armed on the same
+        valset so the next catch-up/consensus step starts at height+1."""
+        from celestia_tpu.state.app import App
+
+        with self._service_lock:
+            if int(meta["height"]) <= self.height:
+                # re-checked under the lock: a concurrent catch-up may
+                # have advanced us; never regress to an older snapshot
+                raise ValueError("snapshot is not ahead of this node")
+            app = App.restore_from_snapshot(
+                chain_id=meta["chain_id"],
+                state=data["state"],
+                height=int(meta["height"]),
+                expected_app_hash=bytes.fromhex(meta["app_hash"]),
+                genesis_time_ns=data.get("genesis_time_ns", 0),
+            )
+            self.app = app
+            self.blocks = []  # height now reads app.store.last_height
+            if self._state_log is not None:
+                # future recoveries replay from this base, not genesis
+                self._state_log.append_checkpoint(
+                    app.store.last_height,
+                    app.store.committed_hash(app.store.last_height),
+                    app.store.raw_state(),
+                )
+                app.store.set_persister(self._persist_commit)
+            if self._bft is not None:
+                self.enable_bft(self._bft_valset)
 
     def bft_drain(self) -> dict:
         """Hand the transport everything outbound: gossip messages and

@@ -677,6 +677,206 @@ def cmd_snapshot(args) -> int:
     return 0
 
 
+def _gentx_sign_doc(decl: dict, chain_id: str) -> bytes:
+    """Canonical bytes a gentx signature covers (sorted-key JSON of the
+    declaration + chain id) — collect verifies the operator actually
+    holds the validator key they are declaring."""
+    import hashlib
+
+    doc = dict(decl)
+    doc.pop("signature", None)
+    doc["chain_id"] = chain_id
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode()
+    ).digest()
+
+
+def cmd_gentx(args) -> int:
+    """``gentx`` (cmd/root.go:131-142 genesis-ceremony role): declare
+    THIS home's validator for a multi-party genesis — a signed JSON the
+    coordinator-less collect-gentxs step verifies and merges."""
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+
+    home = Path(_home(args))
+    key_file = home / "config" / "priv_validator_key.json"
+    genesis_file = home / "config" / "genesis.json"
+    if not key_file.exists() or not genesis_file.exists():
+        raise SystemExit(f"{home} is not initialised (run init first)")
+    if args.power <= 0 or args.self_delegation <= 0:
+        # fail where the value originates, not at the remote collector
+        raise SystemExit("--power and --self-delegation must be > 0")
+    key = PrivateKey(
+        int(json.loads(key_file.read_text())["priv_key"], 16)
+    )
+    chain_id = json.loads(genesis_file.read_text())["chain_id"]
+    addr = key.public_key().address()
+    decl = {
+        "address": addr.hex(),
+        "pubkey": key.public_key().compressed().hex(),
+        "power": args.power,
+        "self_delegation": args.self_delegation,
+        "moniker": args.moniker,
+    }
+    decl["signature"] = key.sign(_gentx_sign_doc(decl, chain_id)).hex()
+    out_dir = home / "config" / "gentx"
+    out_dir.mkdir(exist_ok=True)
+    out = out_dir / f"gentx-{addr.hex()}.json"
+    out.write_text(json.dumps(decl, indent=1))
+    print(json.dumps({"gentx": str(out), "address": addr.hex()}))
+    return 0
+
+
+def cmd_collect_gentxs(args) -> int:
+    """``collect-gentxs``: verify every gentx in --gentx-dir and merge
+    the declared validators (+ funding accounts + the BFT valset file)
+    into this home's genesis.json — a multi-party genesis without the
+    coordinator harness."""
+    from celestia_tpu.utils.secp256k1 import PublicKey
+
+    home = Path(_home(args))
+    genesis_file = home / "config" / "genesis.json"
+    genesis = json.loads(genesis_file.read_text())
+    chain_id = genesis["chain_id"]
+    gentx_dir = Path(args.gentx_dir) if args.gentx_dir else (
+        home / "config" / "gentx"
+    )
+    files = sorted(gentx_dir.glob("gentx-*.json"))
+    if not files:
+        raise SystemExit(f"no gentx-*.json files in {gentx_dir}")
+    validators = {v["address"]: v for v in genesis.get("validators", [])}
+    accounts = {a["address"]: a for a in genesis.get("accounts", [])}
+    valset: dict = {}
+    for path in files:
+        decl = json.loads(path.read_text())
+        pub = PublicKey.from_compressed(bytes.fromhex(decl["pubkey"]))
+        if pub.address().hex() != decl["address"]:
+            raise SystemExit(f"{path.name}: address does not match pubkey")
+        if not pub.verify(
+            _gentx_sign_doc(decl, chain_id),
+            bytes.fromhex(decl["signature"]),
+        ):
+            raise SystemExit(f"{path.name}: invalid gentx signature")
+        if int(decl["power"]) <= 0 or int(decl["self_delegation"]) <= 0:
+            raise SystemExit(f"{path.name}: power/self_delegation must be > 0")
+        addr = decl["address"]
+        vs_entry = {
+            "address": addr,
+            "pubkey": decl["pubkey"],
+            "power": int(decl["power"]),
+        }
+        # two GENTXS for one address must agree exactly; a gentx freely
+        # OVERRIDES a base-genesis validator entry for its own address
+        # (the signature proves the signer owns that validator key, so
+        # they are the authority over their own declaration — e.g. the
+        # placeholder init_home seeds for the home's key)
+        if addr in valset and valset[addr] != vs_entry:
+            raise SystemExit(
+                f"{path.name}: conflicts with another gentx for {addr}"
+            )
+        valset[addr] = vs_entry
+        validators[addr] = {
+            "address": addr,
+            "self_delegation": int(decl["self_delegation"]),
+        }
+        # fund the account with the bond plus a liquid buffer: InitChain
+        # bonds the whole self-delegation, and a validator with zero
+        # spendable balance could not pay its first fee
+        accounts.setdefault(
+            addr,
+            {
+                "address": addr,
+                "balance": int(decl["self_delegation"]) + 1_000_000_000,
+            },
+        )
+    genesis["validators"] = sorted(
+        validators.values(), key=lambda v: v["address"]
+    )
+    genesis["accounts"] = sorted(
+        accounts.values(), key=lambda a: a["address"]
+    )
+    genesis_file.write_text(json.dumps(genesis, indent=1))
+    valset_file = home / "config" / "valset.json"
+    valset_file.write_text(
+        json.dumps(
+            sorted(valset.values(), key=lambda v: v["address"]), indent=1
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "genesis": str(genesis_file),
+                "valset": str(valset_file),
+                "validators": len(valset),
+            }
+        )
+    )
+    return 0
+
+
+def cmd_validate_genesis(args) -> int:
+    """``validate-genesis``: structural checks with precise messages,
+    then the decisive one — a scratch in-memory App actually runs
+    InitChain on the file (what the reference's validate-genesis
+    ultimately guards: will every node accept this genesis?)."""
+    path = Path(args.file) if args.file else (
+        Path(_home(args)) / "config" / "genesis.json"
+    )
+    try:
+        genesis = json.loads(path.read_text())
+    except (OSError, ValueError) as e:
+        print(json.dumps({"valid": False, "errors": [f"unreadable: {e}"]}))
+        return 1
+    errors = []
+    if not isinstance(genesis.get("chain_id"), str) or not genesis["chain_id"]:
+        errors.append("chain_id must be a non-empty string")
+    codec = genesis.get("codec")
+    if codec is not None:
+        from celestia_tpu.ops import gf256
+
+        if codec not in gf256.CODECS:
+            errors.append(f"unknown codec {codec!r} (expected {gf256.CODECS})")
+    seen = set()
+    for i, acc in enumerate(genesis.get("accounts", [])):
+        try:
+            addr = bytes.fromhex(acc["address"])
+            if len(addr) != 20:
+                errors.append(f"accounts[{i}]: address must be 20 bytes")
+            if addr in seen:
+                errors.append(f"accounts[{i}]: duplicate address")
+            seen.add(addr)
+            if int(acc["balance"]) < 0:
+                errors.append(f"accounts[{i}]: negative balance")
+        except (KeyError, ValueError, TypeError) as e:
+            errors.append(f"accounts[{i}]: {e}")
+    seen = set()
+    for i, val in enumerate(genesis.get("validators", [])):
+        try:
+            addr = bytes.fromhex(val["address"])
+            if len(addr) != 20:
+                errors.append(f"validators[{i}]: address must be 20 bytes")
+            if addr in seen:
+                errors.append(f"validators[{i}]: duplicate validator")
+            seen.add(addr)
+            if int(val["self_delegation"]) <= 0:
+                errors.append(f"validators[{i}]: self_delegation must be > 0")
+        except (KeyError, ValueError, TypeError) as e:
+            errors.append(f"validators[{i}]: {e}")
+    if not errors:
+        # the decisive check: InitChain on a scratch app
+        from celestia_tpu.ops import gf256
+        from celestia_tpu.state.app import App
+
+        prev_codec = gf256.active_codec()
+        try:
+            App(chain_id=genesis.get("chain_id", "x")).init_chain(genesis)
+        except Exception as e:
+            errors.append(f"InitChain rejected the genesis: {e}")
+        finally:
+            gf256.set_active_codec(prev_codec)
+    print(json.dumps({"valid": not errors, "errors": errors}))
+    return 0 if not errors else 1
+
+
 def cmd_txsim(args) -> int:
     """Load generator against a running node (test/cmd/txsim parity)."""
     from celestia_tpu.client.signer import Signer
@@ -924,6 +1124,33 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--timeout", type=float, default=120.0,
                     help="per-RPC timeout in seconds")
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser(
+        "gentx", help="declare this home's validator for a shared genesis"
+    )
+    sp.add_argument("--self-delegation", type=int, default=100_000_000)
+    sp.add_argument("--power", type=int, default=100)
+    sp.add_argument("--moniker", default="")
+    sp.set_defaults(fn=cmd_gentx)
+
+    sp = sub.add_parser(
+        "collect-gentxs",
+        help="verify + merge gentx files into genesis.json and valset.json",
+    )
+    sp.add_argument(
+        "--gentx-dir", default=None,
+        help="directory of gentx-*.json files (default: home/config/gentx)",
+    )
+    sp.set_defaults(fn=cmd_collect_gentxs)
+
+    sp = sub.add_parser(
+        "validate-genesis", help="check a genesis file incl. a scratch InitChain"
+    )
+    sp.add_argument(
+        "--file", default=None,
+        help="genesis path (default: home/config/genesis.json)",
+    )
+    sp.set_defaults(fn=cmd_validate_genesis)
 
     sp = sub.add_parser("txsim", help="transaction load generator")
     sp.add_argument("--node", default="127.0.0.1:9090")

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 import tomllib
 from dataclasses import asdict, dataclass, field
 from pathlib import Path
@@ -191,7 +192,10 @@ def init_home(
     val_addr = val_key.public_key().address()
     genesis = {
         "chain_id": chain_id,
-        "genesis_time_ns": 0,
+        # a CONCRETE genesis time, pinned at init: 0 means "unset" to the
+        # node (it would substitute per-node wall clock — diverging app
+        # hashes across a shared-genesis ceremony)
+        "genesis_time_ns": time.time_ns(),
         "accounts": [
             {"address": val_addr.hex(), "balance": 1_000_000_000_000}
         ]

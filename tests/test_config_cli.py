@@ -177,3 +177,112 @@ def test_cli_das_and_namespace_queries(tmp_path, capsys):
         assert blobs[0][1] == data
     finally:
         server.stop()
+
+
+def test_genesis_ceremony_gentx_collect_validate(tmp_path):
+    """Multi-party genesis without the coordinator harness (VERDICT r4
+    #9; cmd/root.go:131-142): three operators init homes, each produces
+    a signed gentx, one collects them into genesis.json + valset.json,
+    validate-genesis passes (incl. the scratch InitChain), and an
+    in-process 3-validator BFT mesh built EXACTLY from those files
+    commits blocks — the ceremony output is usable, not just parseable."""
+    import shutil
+    import time
+
+    import numpy as np
+
+    from celestia_tpu.cli import main
+
+    homes = [str(tmp_path / f"v{i}") for i in range(3)]
+    # operator 0 makes the shared base genesis; everyone initialises
+    assert main(["--home", homes[0], "init", "--chain-id", "ceremony-1"]) == 0
+    shared = tmp_path / "shared-genesis.json"
+    g0 = json.loads(
+        (tmp_path / "v0" / "config" / "genesis.json").read_text()
+    )
+    g0["validators"] = []  # validators come ONLY from gentxs
+    shared.write_text(json.dumps(g0))
+    (tmp_path / "v0" / "config" / "genesis.json").write_text(
+        json.dumps(g0)
+    )
+    for home in homes[1:]:
+        assert main(
+            ["--home", home, "init", "--chain-id", "ceremony-1",
+             "--genesis", str(shared)]
+        ) == 0
+    # each operator declares their validator
+    for home in homes:
+        assert main(["--home", home, "gentx", "--power", "100"]) == 0
+    # operator 0 collects all gentx files
+    pool = tmp_path / "gentxs"
+    pool.mkdir()
+    from pathlib import Path
+
+    for home in homes:
+        for f in (Path(home) / "config" / "gentx").glob("gentx-*.json"):
+            shutil.copy(f, pool / f.name)
+    assert main(
+        ["--home", homes[0], "collect-gentxs", "--gentx-dir", str(pool)]
+    ) == 0
+    assert main(["--home", homes[0], "validate-genesis"]) == 0
+    # a tampered gentx must be rejected
+    bad = json.loads(next(pool.glob("gentx-*.json")).read_text())
+    bad["power"] = 10**6  # not covered by the signature anymore
+    next(pool.glob("gentx-*.json")).write_text(json.dumps(bad))
+    with pytest.raises(SystemExit):
+        main(["--home", homes[0], "collect-gentxs", "--gentx-dir", str(pool)])
+    # boot a 3-validator in-process mesh from the ceremony's exact output
+    from celestia_tpu.da import dah as dah_mod
+
+    for k in (1, 2):
+        dah_mod.extend_and_header(np.zeros((k, k, 512), dtype=np.uint8))
+    from celestia_tpu.node.gossip import GossipEngine
+    from celestia_tpu.node.server import NodeServer
+    from celestia_tpu.node.testnode import TestNode
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+
+    genesis = json.loads(
+        (tmp_path / "v0" / "config" / "genesis.json").read_text()
+    )
+    valset = json.loads(
+        (tmp_path / "v0" / "config" / "valset.json").read_text()
+    )
+    keys = [
+        PrivateKey(
+            int(
+                json.loads(
+                    (tmp_path / f"v{i}" / "config" /
+                     "priv_validator_key.json").read_text()
+                )["priv_key"], 16,
+            )
+        )
+        for i in range(3)
+    ]
+    nodes, servers, engines = [], [], []
+    try:
+        for i in range(3):
+            node = TestNode(
+                chain_id="ceremony-1", genesis=genesis,
+                validator_key=keys[i], auto_produce=False,
+            )
+            node.enable_bft(valset)
+            srv = NodeServer(node, block_interval_s=None)
+            srv.start()
+            nodes.append(node)
+            servers.append(srv)
+        for i, node in enumerate(nodes):
+            peers = [s.address for j, s in enumerate(servers) if j != i]
+            eng = GossipEngine(node, peers, block_gap_s=0.05)
+            engines.append(eng)
+            eng.start()
+        deadline = time.time() + 90
+        while not all(n.height >= 2 for n in nodes):
+            assert time.time() < deadline, (
+                f"ceremony mesh stuck: {[n.height for n in nodes]}"
+            )
+            time.sleep(0.05)
+    finally:
+        for e in engines:
+            e.stop()
+        for s in servers:
+            s.stop()

@@ -78,4 +78,7 @@ def test_mesh_validation():
         sharded.make_mesh(jax.devices(), data=3, row=4)
     mesh = sharded.make_mesh(data=1, row=8)
     with pytest.raises(ValueError, match="divisible"):
-        sharded._sharded_fn(mesh, 4, False)  # k=4 rows over 8 shards
+        from celestia_tpu.ops import gf256
+
+        # k=4 rows over 8 shards (codec is a required cache key)
+        sharded._sharded_fn(mesh, 4, False, gf256.active_codec())

@@ -242,6 +242,10 @@ def cmd_start(args) -> int:
             snapshot_keep_recent=cfg.snapshot.keep_recent,
             data_dir=data_dir,
         )
+    if node.genesis_doc is None:
+        # recovery / snapshot-restore paths skip InitChain, but the home
+        # still has the genesis file — keep serving it to joining peers
+        node.genesis_doc = genesis
     if getattr(args, "bft_valset", None):
         # two-phase BFT mode: this node votes with its own key and
         # commits only on a 2/3 precommit quorum it verified itself
@@ -813,19 +817,9 @@ def cmd_collect_gentxs(args) -> int:
     return 0
 
 
-def cmd_validate_genesis(args) -> int:
-    """``validate-genesis``: structural checks with precise messages,
-    then the decisive one — a scratch in-memory App actually runs
-    InitChain on the file (what the reference's validate-genesis
-    ultimately guards: will every node accept this genesis?)."""
-    path = Path(args.file) if args.file else (
-        Path(_home(args)) / "config" / "genesis.json"
-    )
-    try:
-        genesis = json.loads(path.read_text())
-    except (OSError, ValueError) as e:
-        print(json.dumps({"valid": False, "errors": [f"unreadable: {e}"]}))
-        return 1
+def _genesis_errors(genesis: dict) -> list:
+    """Structural checks + the decisive scratch InitChain — shared by
+    validate-genesis and download-genesis."""
     errors = []
     if not isinstance(genesis.get("chain_id"), str) or not genesis["chain_id"]:
         errors.append("chain_id must be a non-empty string")
@@ -862,7 +856,6 @@ def cmd_validate_genesis(args) -> int:
         except (KeyError, ValueError, TypeError) as e:
             errors.append(f"validators[{i}]: {e}")
     if not errors:
-        # the decisive check: InitChain on a scratch app
         from celestia_tpu.ops import gf256
         from celestia_tpu.state.app import App
 
@@ -873,6 +866,104 @@ def cmd_validate_genesis(args) -> int:
             errors.append(f"InitChain rejected the genesis: {e}")
         finally:
             gf256.set_active_codec(prev_codec)
+    return errors
+
+
+def cmd_download_genesis(args) -> int:
+    """``download-genesis``: fetch the chain's genesis document from a
+    running peer over gRPC and install it into this home (the
+    reference's download-genesis role, cmd/root.go:131-142).  The doc is
+    validated with a scratch InitChain before anything is written; for a
+    real deployment cross-check the chain id out of band — one serving
+    peer is not a trust anchor."""
+    from celestia_tpu.client.remote import RemoteNode
+
+    home = Path(_home(args))
+    cfg_dir = home / "config"
+    if not cfg_dir.exists():
+        raise SystemExit(f"{home} is not initialised (run init first)")
+    cli = RemoteNode(args.node, timeout_s=args.timeout)
+    try:
+        doc = cli.genesis()
+    finally:
+        cli.close()
+    if not doc:
+        raise SystemExit(f"{args.node} does not serve a genesis document")
+    errors = _genesis_errors(doc)
+    if errors:
+        raise SystemExit(
+            "downloaded genesis is invalid: " + "; ".join(errors)
+        )
+    (cfg_dir / "genesis.json").write_text(json.dumps(doc, indent=1))
+    print(
+        json.dumps(
+            {"genesis": str(cfg_dir / "genesis.json"),
+             "chain_id": doc.get("chain_id")}
+        )
+    )
+    return 0
+
+
+def cmd_migrate_genesis(args) -> int:
+    """``migrate-genesis``: bring an older genesis file to the current
+    shape.  Applied migrations: pin the pre-ADR-012 codec explicitly
+    (files without a codec key ran the lagrange codec — leaving it
+    implicit would flip them to the new leopard default), and sort
+    accounts/validators into canonical order.  A concrete genesis time
+    cannot be invented for an old chain; a missing/zero one is reported
+    so the operator supplies the original."""
+    from celestia_tpu.ops import gf256
+
+    path = Path(args.file) if args.file else (
+        Path(_home(args)) / "config" / "genesis.json"
+    )
+    try:
+        genesis = json.loads(path.read_text())
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"cannot read genesis {path}: {e}")
+    applied = []
+    if "codec" not in genesis:
+        genesis["codec"] = gf256.CODEC_LAGRANGE
+        applied.append("pinned pre-ADR-012 codec lagrange-gf256")
+    for section in ("accounts", "validators"):
+        entries = genesis.get(section)
+        if not entries:
+            continue
+        ordered = sorted(entries, key=lambda e: e["address"])
+        if entries != ordered:
+            genesis[section] = ordered
+            applied.append(f"canonicalized {section} order")
+    warnings = []
+    if not genesis.get("genesis_time_ns"):
+        warnings.append(
+            "genesis_time_ns is unset/zero: supply the chain's original "
+            "time or nodes will substitute their own wall clock"
+        )
+    out_path = Path(args.output) if args.output else path
+    out_path.write_text(json.dumps(genesis, indent=1))
+    print(
+        json.dumps(
+            {"output": str(out_path), "applied": applied,
+             "warnings": warnings}
+        )
+    )
+    return 0
+
+
+def cmd_validate_genesis(args) -> int:
+    """``validate-genesis``: structural checks with precise messages,
+    then the decisive one — a scratch in-memory App actually runs
+    InitChain on the file (what the reference's validate-genesis
+    ultimately guards: will every node accept this genesis?)."""
+    path = Path(args.file) if args.file else (
+        Path(_home(args)) / "config" / "genesis.json"
+    )
+    try:
+        genesis = json.loads(path.read_text())
+    except (OSError, ValueError) as e:
+        print(json.dumps({"valid": False, "errors": [f"unreadable: {e}"]}))
+        return 1
+    errors = _genesis_errors(genesis)
     print(json.dumps({"valid": not errors, "errors": errors}))
     return 0 if not errors else 1
 
@@ -1151,6 +1242,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="genesis path (default: home/config/genesis.json)",
     )
     sp.set_defaults(fn=cmd_validate_genesis)
+
+    sp = sub.add_parser(
+        "download-genesis",
+        help="fetch + validate the genesis document from a running peer",
+    )
+    sp.add_argument("--node", default="127.0.0.1:9090")
+    sp.add_argument("--timeout", type=float, default=120.0)
+    sp.set_defaults(fn=cmd_download_genesis)
+
+    sp = sub.add_parser(
+        "migrate-genesis",
+        help="bring an older genesis file to the current shape",
+    )
+    sp.add_argument("--file", default=None)
+    sp.add_argument("--output", default=None,
+                    help="write here instead of in place")
+    sp.set_defaults(fn=cmd_migrate_genesis)
 
     sp = sub.add_parser("txsim", help="transaction load generator")
     sp.add_argument("--node", default="127.0.0.1:9090")

@@ -201,6 +201,11 @@ class RemoteNode:
         )
         return list(out.get("peers", []))
 
+    def genesis(self):
+        """The peer's genesis document, or None (download-genesis)."""
+        out = self._call_json("Genesis", {})
+        return out.get("genesis") if out.get("found") else None
+
     # -- state-sync (snapshot serving) ----------------------------------
 
     def snapshot_list(self) -> list:

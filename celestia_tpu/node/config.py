@@ -189,6 +189,8 @@ def init_home(
     (cfg_dir / "priv_validator_key.json").write_text(
         json.dumps({"priv_key": val_key.d.to_bytes(32, "big").hex()}, indent=1)
     )
+    from celestia_tpu.ops.gf256 import CODEC_LEOPARD
+
     val_addr = val_key.public_key().address()
     genesis = {
         "chain_id": chain_id,
@@ -196,6 +198,10 @@ def init_home(
         # node (it would substitute per-node wall clock — diverging app
         # hashes across a shared-genesis ceremony)
         "genesis_time_ns": time.time_ns(),
+        # the codec is written EXPLICITLY so "no codec key" always means
+        # a pre-ADR-012 file (migrate-genesis pins those to lagrange);
+        # leaving it implicit would make that inference ambiguous
+        "codec": CODEC_LEOPARD,
         "accounts": [
             {"address": val_addr.hex(), "balance": 1_000_000_000_000}
         ]

@@ -219,6 +219,16 @@ class NodeService:
         want = eng.on_tx_have(hashes) if eng is not None else []
         return json.dumps({"want": [h.hex() for h in want]}).encode()
 
+    def genesis(self, req: bytes, ctx) -> bytes:
+        """Serve the chain's genesis document (download-genesis role,
+        cmd/root.go:131-142).  The caller should validate it and, for a
+        real deployment, cross-check the chain id / app hash out of
+        band — a single serving peer is not a trust anchor."""
+        doc = getattr(self.node, "genesis_doc", None)
+        return json.dumps(
+            {"found": doc is not None, "genesis": doc or {}}
+        ).encode()
+
     def snapshot_list(self, req: bytes, ctx) -> bytes:
         """State-sync serving (root.go:227-243 role): metadata of the
         snapshots this node can serve, incl. per-chunk hashes."""
@@ -293,6 +303,7 @@ class NodeService:
             "PeerExchange": self.peer_exchange,
             "SnapshotList": self.snapshot_list,
             "SnapshotChunk": self.snapshot_chunk,
+            "Genesis": self.genesis,
         }
         method_handlers = {
             name: grpc.unary_unary_rpc_method_handler(

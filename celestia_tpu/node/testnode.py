@@ -91,6 +91,10 @@ class TestNode:
         self.data_dir = data_dir
         self._state_log = None
         self._block_log = None
+        # genesis document served to joining peers (download-genesis);
+        # set below on the fresh-InitChain path, or by the CLI on the
+        # recovery/snapshot-restore paths (which never re-run InitChain)
+        self.genesis_doc: Optional[dict] = None
         recovered_blocks: List[Block] = []
         disk_recovered = False
         if data_dir and app is None:
@@ -217,6 +221,9 @@ class TestNode:
             if not genesis.get("genesis_time_ns"):
                 genesis["genesis_time_ns"] = genesis_time_ns or _time.time_ns()
         self.app.init_chain(genesis)
+        # retained so joining peers can download the genesis document
+        # over gRPC (the reference's download-genesis role)
+        self.genesis_doc = genesis
         self._now_ns = self.app.genesis_time_ns
 
     # ------------------------------------------------------------------

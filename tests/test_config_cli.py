@@ -286,3 +286,62 @@ def test_genesis_ceremony_gentx_collect_validate(tmp_path):
             e.stop()
         for s in servers:
             s.stop()
+
+
+def test_download_and_migrate_genesis(tmp_path):
+    """download-genesis fetches + InitChain-validates the doc from a live
+    peer; migrate-genesis pins the pre-ADR-012 codec explicitly and
+    canonicalizes ordering (cmd/root.go:131-142 utilities)."""
+    from celestia_tpu.cli import main
+    from celestia_tpu.node.server import NodeServer
+    from celestia_tpu.node.testnode import TestNode
+    from celestia_tpu.ops import gf256
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+
+    key = PrivateKey.from_seed(b"dl-genesis")
+    genesis = {
+        "chain_id": "dl-chain-1",
+        "genesis_time_ns": 1_700_000_000_000_000_000,
+        "accounts": [
+            {"address": key.public_key().address().hex(), "balance": 10**12}
+        ],
+        "validators": [],
+    }
+    node = TestNode(
+        chain_id="dl-chain-1", genesis=genesis, auto_produce=False
+    )
+    srv = NodeServer(node, block_interval_s=None)
+    srv.start()
+    try:
+        home = str(tmp_path / "joiner")
+        assert main(["--home", home, "init", "--chain-id", "placeholder"]) == 0
+        assert main(
+            ["--home", home, "download-genesis", "--node", srv.address]
+        ) == 0
+        got = json.loads(
+            (tmp_path / "joiner" / "config" / "genesis.json").read_text()
+        )
+        assert got["chain_id"] == "dl-chain-1"
+        assert got["genesis_time_ns"] == genesis["genesis_time_ns"]
+    finally:
+        srv.stop()
+
+    # migrate: a pre-ADR-012 file (no codec key, unsorted accounts)
+    old = tmp_path / "old-genesis.json"
+    old.write_text(json.dumps({
+        "chain_id": "old-1",
+        "genesis_time_ns": 5,
+        "accounts": [
+            {"address": "ff" * 20, "balance": 1},
+            {"address": "aa" * 20, "balance": 2},
+        ],
+        "validators": [],
+    }))
+    out = tmp_path / "migrated.json"
+    assert main([
+        "migrate-genesis", "--file", str(old), "--output", str(out)
+    ]) == 0
+    migrated = json.loads(out.read_text())
+    assert migrated["codec"] == gf256.CODEC_LAGRANGE
+    assert [a["address"] for a in migrated["accounts"]] == ["aa" * 20, "ff" * 20]
+    assert main(["validate-genesis", "--file", str(out)]) == 0

@@ -882,6 +882,18 @@ def cmd_download_genesis(args) -> int:
     cfg_dir = home / "config"
     if not cfg_dir.exists():
         raise SystemExit(f"{home} is not initialised (run init first)")
+    blocks_log = home / "data" / "blocks.log"
+    if (
+        not args.force
+        and blocks_log.exists()
+        and blocks_log.stat().st_size > 0
+    ):
+        # replacing the genesis under an existing chain's data dir would
+        # pair one chain's blocks with another's genesis on next start
+        raise SystemExit(
+            f"{home} already holds chain data ({blocks_log}); refusing to "
+            "replace its genesis — use --force after clearing data/"
+        )
     cli = RemoteNode(args.node, timeout_s=args.timeout)
     try:
         doc = cli.genesis()
@@ -906,12 +918,14 @@ def cmd_download_genesis(args) -> int:
 
 def cmd_migrate_genesis(args) -> int:
     """``migrate-genesis``: bring an older genesis file to the current
-    shape.  Applied migrations: pin the pre-ADR-012 codec explicitly
-    (files without a codec key ran the lagrange codec — leaving it
-    implicit would flip them to the new leopard default), and sort
-    accounts/validators into canonical order.  A concrete genesis time
-    cannot be invented for an old chain; a missing/zero one is reported
-    so the operator supplies the original."""
+    shape.  A file without a codec key is AMBIGUOUS (chains started
+    before ADR-012 ran lagrange; files generated by a post-ADR-012
+    ``init`` that predates the explicit key ran leopard), so the
+    operator must state which chain the file belongs to via
+    ``--assume-codec`` — guessing could silently flip the consensus
+    codec.  Ordering is canonicalized, the result is validated with the
+    same gate as validate-genesis, and an unset genesis time is
+    reported (it cannot be invented for an existing chain)."""
     from celestia_tpu.ops import gf256
 
     path = Path(args.file) if args.file else (
@@ -923,31 +937,45 @@ def cmd_migrate_genesis(args) -> int:
         raise SystemExit(f"cannot read genesis {path}: {e}")
     applied = []
     if "codec" not in genesis:
-        genesis["codec"] = gf256.CODEC_LAGRANGE
-        applied.append("pinned pre-ADR-012 codec lagrange-gf256")
-    for section in ("accounts", "validators"):
-        entries = genesis.get(section)
-        if not entries:
-            continue
-        ordered = sorted(entries, key=lambda e: e["address"])
-        if entries != ordered:
-            genesis[section] = ordered
-            applied.append(f"canonicalized {section} order")
+        if not args.assume_codec:
+            raise SystemExit(
+                "genesis has no codec key; state the chain's codec with "
+                f"--assume-codec {{{', '.join(gf256.CODECS)}}} "
+                "(pre-ADR-012 chains ran lagrange-gf256; post-ADR-012 "
+                "inits without the key ran leopard-ff8)"
+            )
+        if args.assume_codec not in gf256.CODECS:
+            raise SystemExit(f"unknown codec {args.assume_codec!r}")
+        genesis["codec"] = args.assume_codec
+        applied.append(f"pinned codec {args.assume_codec}")
+    try:
+        for section in ("accounts", "validators"):
+            entries = genesis.get(section)
+            if not entries:
+                continue
+            ordered = sorted(entries, key=lambda e: e["address"])
+            if entries != ordered:
+                genesis[section] = ordered
+                applied.append(f"canonicalized {section} order")
+    except (KeyError, TypeError) as e:
+        raise SystemExit(f"malformed {section} entry: {e}")
     warnings = []
     if not genesis.get("genesis_time_ns"):
         warnings.append(
             "genesis_time_ns is unset/zero: supply the chain's original "
             "time or nodes will substitute their own wall clock"
         )
+    errors = _genesis_errors(genesis)
     out_path = Path(args.output) if args.output else path
-    out_path.write_text(json.dumps(genesis, indent=1))
+    if not errors:
+        out_path.write_text(json.dumps(genesis, indent=1))
     print(
         json.dumps(
-            {"output": str(out_path), "applied": applied,
-             "warnings": warnings}
+            {"output": str(out_path) if not errors else None,
+             "applied": applied, "warnings": warnings, "errors": errors}
         )
     )
-    return 0
+    return 0 if not errors else 1
 
 
 def cmd_validate_genesis(args) -> int:
@@ -1249,6 +1277,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("--node", default="127.0.0.1:9090")
     sp.add_argument("--timeout", type=float, default=120.0)
+    sp.add_argument(
+        "--force", action="store_true",
+        help="replace the genesis even though the home holds chain data",
+    )
     sp.set_defaults(fn=cmd_download_genesis)
 
     sp = sub.add_parser(
@@ -1258,6 +1290,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--file", default=None)
     sp.add_argument("--output", default=None,
                     help="write here instead of in place")
+    sp.add_argument(
+        "--assume-codec", default=None,
+        help="codec to pin when the file has no codec key (required then)",
+    )
     sp.set_defaults(fn=cmd_migrate_genesis)
 
     sp = sub.add_parser("txsim", help="transaction load generator")

@@ -338,8 +338,12 @@ def test_download_and_migrate_genesis(tmp_path):
         "validators": [],
     }))
     out = tmp_path / "migrated.json"
+    # codec-less files are ambiguous: migrate must refuse to guess
+    with pytest.raises(SystemExit):
+        main(["migrate-genesis", "--file", str(old), "--output", str(out)])
     assert main([
-        "migrate-genesis", "--file", str(old), "--output", str(out)
+        "migrate-genesis", "--file", str(old), "--output", str(out),
+        "--assume-codec", "lagrange-gf256",
     ]) == 0
     migrated = json.loads(out.read_text())
     assert migrated["codec"] == gf256.CODEC_LAGRANGE

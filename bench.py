@@ -431,6 +431,34 @@ def _prepare_proposal_ms(k: int):
     return float(np.median(times)), prop.square_size, len(txs), breakdown
 
 
+def _host_repair_ms(k: int):
+    """Host-only repair (the light-client/DAS path — no accelerator):
+    25% withheld, root-verified.  Under the leopard codec this runs the
+    O(n log n) FFT erasure decode + FFT re-extension
+    (native leo_decode_axes / extend_block_leopard_cpu)."""
+    from celestia_tpu.ops import rs
+    from celestia_tpu.utils import native
+
+    if not native.available():
+        return None
+    rng = np.random.default_rng(3)
+    sq = rng.integers(0, 256, (k, k, 512), dtype=np.uint8)
+    eds, roots, _ = native.extend_block_leopard_cpu(sq, nthreads=0)
+    rr, cc = roots[: 2 * k], roots[2 * k :]
+    avail = rng.random((2 * k, 2 * k)) >= 0.25
+    damaged = eds.copy()
+    damaged[~avail] = 0
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        fixed = rs.repair_square(
+            damaged, avail, row_roots=rr, col_roots=cc
+        )
+        times.append((time.time() - t0) * 1000.0)
+    assert np.array_equal(fixed, eds), "host repair produced a wrong square"
+    return float(np.median(times))
+
+
 def _glv_us_per_sig(n: int = 256):
     """Native batched ECDSA verify, µs per signature (ADR-011 host leg) —
     8 distinct senders so the pubkey-decompression cache behaves like a
@@ -506,6 +534,12 @@ def _host_only_main():
         extras["glv_us_per_sig"] = round(_glv_us_per_sig(), 1)
     except Exception as e:
         extras["glv_error"] = repr(e)[:200]
+    try:
+        host_repair = _host_repair_ms(K)
+        if host_repair is not None:
+            extras[f"repair_{K}_host_25pct_ms"] = round(host_repair, 1)
+    except Exception as e:
+        extras["host_repair_error"] = repr(e)[:200]
     leg = extras.get("cpu_leg", "table_gf_cpu")
     print(
         json.dumps(
@@ -614,6 +648,12 @@ def main():
         extras["glv_us_per_sig"] = round(_glv_us_per_sig(), 1)
     except Exception as e:
         extras["glv_error"] = repr(e)[:200]
+    try:
+        host_repair = _host_repair_ms(k)
+        if host_repair is not None:
+            extras[f"repair_{k}_host_25pct_ms"] = round(host_repair, 1)
+    except Exception as e:
+        extras["host_repair_error"] = repr(e)[:200]
     try:
         # Go-fixture gate on the DEVICE path (only meaningful at k=128)
         if k == 128:

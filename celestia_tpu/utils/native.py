@@ -88,6 +88,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.extend_block_leopard_cpu.argtypes = [
             u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p, u8p, u8p,
         ]
+        lib.leo_decode_axes.argtypes = [
+            u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p,
+            ctypes.c_int,
+        ]
     except AttributeError:
         # stale .so without the codec symbols: the GF legs would compute
         # in the WRONG field for the leopard codec (gf_load_mul missing),
@@ -227,6 +231,35 @@ def leo_extend_square(square: np.ndarray, nthreads: int = 0) -> np.ndarray:
     eds = np.zeros((2 * k, 2 * k, B), dtype=np.uint8)
     lib.leo_extend_square_cpu(_ptr(square), _ptr(eds), k, B, nthreads)
     return eds
+
+
+def leo_decode_axes(
+    data: np.ndarray, present: np.ndarray, nthreads: int = 0
+) -> np.ndarray:
+    """Leopard O(n log n) erasure decode, IN PLACE, threaded across axes.
+
+    data uint8[n_axes, 2k, B]: axis rows in EDS position order with
+    erased rows zeroed; present uint8[n_axes, 2k] marks received rows.
+    Returns ok uint8[n_axes] (0 = fewer than k rows present).  Leopard
+    codec only — the caller must hold the leopard-ff8 codec active."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    if not data.flags.c_contiguous or data.dtype != np.uint8:
+        raise ValueError("data must be C-contiguous uint8 (decoded in place)")
+    present = np.ascontiguousarray(present, dtype=np.uint8)
+    n_axes, n, B = data.shape
+    if present.shape != (n_axes, n):
+        raise ValueError(f"present must be ({n_axes}, {n})")
+    # the C side uses fixed 256-entry domain buffers (the field has 256
+    # points); an oversized axis must fail HERE, not smash the stack
+    if not (1 <= n <= 256) or n & (n - 1):
+        raise ValueError(f"axis length must be a power of two <= 256, got {n}")
+    ok = np.zeros(n_axes, dtype=np.uint8)
+    lib.leo_decode_axes(
+        _ptr(data), _ptr(present), n_axes, n, B, _ptr(ok), nthreads
+    )
+    return ok
 
 
 def extend_block_leopard_cpu(square: np.ndarray, nthreads: int = 0):

@@ -794,6 +794,160 @@ void leo_extend_square_cpu(const uint8_t* square, uint8_t* eds, int k, int B,
         &ctx, n, nthreads);
 }
 
+// --- Leopard O(n log n) ERASURE DECODE -------------------------------------
+//
+// Forney-style over the novel basis: with erasure set M and data poly F
+// (deg < k), let E(x) = prod_{m in M} (x ^ x_m).  W = E*F has known
+// evaluations EVERYWHERE on the n-point domain: W(x_i) = r_i*E(x_i) at
+// received points, 0 at erased ones.  IFFT yields W's novel-basis
+// coefficients (deg(E*F) <= |M|+k-1 <= n-1 since |M| <= k).  Both W and
+// E vanish at x_m, so F(x_m) = W'(x_m) / E'(x_m).
+//
+// The formal derivative is CLEAN in the normalized novel basis: each
+// basis factor s_i = W_i/W_i(v_i) is a linearized polynomial, so
+// s_i' is the constant c_i = W_i'(0)/W_i(v_i) with
+// W_i'(0) = prod_{v in V_i, v != 0} v, and
+//   (X_j)' = sum_{i in bits(j)} c_i * X_{j - 2^i}
+// i.e. derivative = for each bit level i: coeff[j - 2^i] ^= c_i * coeff[j].
+//
+// E' at an erased point: E'(x_m) = prod_{m' != m} (x_m ^ x_{m'}) (the
+// product rule collapses — every other term contains the (x ^ x_m)
+// factor).  All in the Cantor-index field; position -> point is XOR k.
+
+static uint8_t LEO_DERIV_C[8];  // c_i per bit level
+static int leo_deriv_ready = 0;
+
+static void leo_deriv_init(void) {
+    if (leo_deriv_ready) return;
+    leo_init();
+    for (int i = 0; i < 8; i++) {
+        // W_i'(0) = prod of nonzero elements of V_i = span{v_0..v_{i-1}}
+        uint8_t num = 1;
+        for (int v = 1; v < (1 << i); v++) num = LEO_MUL_TAB[num][(uint8_t)v];
+        // W_i(v_i): evaluate prod_{v in V_i} (v_i ^ v) directly
+        uint8_t den = 1;
+        for (int v = 0; v < (1 << i); v++)
+            den = LEO_MUL_TAB[den][(uint8_t)((1 << i) ^ v)];
+        // c_i = num / den
+        uint8_t inv = 1, acc = den;  // den^254 = den^-1 (Fermat, 2^8)
+        for (int e = 0; e < 7; e++) {
+            acc = LEO_MUL_TAB[acc][acc];
+            inv = LEO_MUL_TAB[inv][acc];
+        }
+        LEO_DERIV_C[i] = LEO_MUL_TAB[num][inv];
+    }
+    leo_deriv_ready = 1;
+}
+
+static inline uint8_t leo_inv_scalar(uint8_t a) {
+    uint8_t inv = 1, acc = a;  // a^254
+    for (int e = 0; e < 7; e++) {
+        acc = LEO_MUL_TAB[acc][acc];
+        inv = LEO_MUL_TAB[inv][acc];
+    }
+    return inv;
+}
+
+// Decode ONE axis in place.  shards: n x B rows in EDS POSITION order
+// (data rows [0,k), parity rows [k,2k)); present: n bytes (0/1).
+// Erased rows are overwritten with the reconstruction.  Returns 1 on
+// success, 0 if fewer than k rows are present.  work must hold 2*n*B
+// (coefficients + the derivative output).
+int leo_decode_axis(uint8_t* shards, const uint8_t* present, int n, int B,
+                    uint8_t* work) {
+    leo_deriv_init();
+    const int k = n / 2;
+    int n_present = 0;
+    for (int i = 0; i < n; i++) n_present += present[i] ? 1 : 0;
+    if (n_present < k) return 0;
+    if (n_present == n) return 1;
+    // point domain: point j <-> position j ^ k
+    uint8_t eloc[256];  // E evaluated at every domain point
+    uint8_t is_erased[256];
+    for (int j = 0; j < n; j++) {
+        is_erased[j] = !present[j ^ k];
+        eloc[j] = 1;
+    }
+    for (int m = 0; m < n; m++) {
+        if (!is_erased[m]) continue;
+        for (int j = 0; j < n; j++) {
+            if (j == m) continue;  // skip only the OWN factor
+            eloc[j] = LEO_MUL_TAB[eloc[j]][(uint8_t)(j ^ m)];
+        }
+    }
+    // After the passes: a RECEIVED point j accumulated every erased
+    // factor -> eloc[j] = E(x_j); an ERASED point m accumulated every
+    // factor but its own -> eloc[m] = prod_{m' != m}(x_m ^ x_{m'})
+    // = E'(x_m) (the product-rule survivor).  Never zero an entry: the
+    // is_erased flag is what distinguishes the two meanings.
+    // W evaluations into work (point order)
+    for (int j = 0; j < n; j++) {
+        uint8_t* dst = work + (size_t)j * B;
+        if (is_erased[j]) {
+            memset(dst, 0, B);
+        } else {
+            const uint8_t* row = LEO_MUL_TAB[eloc[j]];
+            const uint8_t* src = shards + (size_t)(j ^ k) * B;
+            if (eloc[j] == 0) {
+                memset(dst, 0, B);
+            } else {
+                for (int b = 0; b < B; b++) dst[b] = row[src[b]];
+            }
+        }
+    }
+    leo_ifft(work, n, 0, B);  // novel-basis coefficients of W
+    // formal derivative into a SEPARATE buffer: b'_m = sum over clear
+    // bits i of m of c_i * b_{m + 2^i}.  It must not run in place — the
+    // original b_m does not belong in the output, and later levels must
+    // read unmutated inputs.
+    uint8_t* deriv = work + (size_t)n * B;
+    memset(deriv, 0, (size_t)n * B);
+    for (int i = 0; (1 << i) < n; i++) {
+        const uint8_t c = LEO_DERIV_C[i];
+        for (int m = 0; m < n; m++) {
+            if (m & (1 << i)) continue;
+            leo_mul_add(deriv + (size_t)m * B,
+                        work + (size_t)(m + (1 << i)) * B, c, B);
+        }
+    }
+    leo_fft(deriv, n, 0, B);  // W' evaluated at every domain point
+    for (int m = 0; m < n; m++) {
+        if (!is_erased[m]) continue;
+        const uint8_t scale = leo_inv_scalar(eloc[m]);  // 1 / E'(x_m)
+        uint8_t* dst = shards + (size_t)(m ^ k) * B;
+        const uint8_t* row = LEO_MUL_TAB[scale];
+        const uint8_t* src = deriv + (size_t)m * B;
+        for (int b = 0; b < B; b++) dst[b] = row[src[b]];
+    }
+    return 1;
+}
+
+// Threaded batch: axes x n x B, one availability row each.
+void leo_decode_axes(uint8_t* data, const uint8_t* present, int n_axes,
+                     int n, int B, uint8_t* ok, int nthreads) {
+    leo_deriv_init();
+    if (nthreads <= 0) {
+        nthreads = (int)std::thread::hardware_concurrency();
+        if (nthreads <= 0) nthreads = 1;
+    }
+    struct Ctx {
+        uint8_t* data;
+        const uint8_t* present;
+        int n_axes, n, B;
+        uint8_t* ok;
+    } ctx = {data, present, n_axes, n, B, ok};
+    run_striped(
+        [](void* p, int t, int nt) {
+            Ctx& c = *(Ctx*)p;
+            std::vector<uint8_t> work(2 * (size_t)c.n * c.B);
+            for (int a = t; a < c.n_axes; a += nt)
+                c.ok[a] = (uint8_t)leo_decode_axis(
+                    c.data + (size_t)a * c.n * c.B,
+                    c.present + (size_t)a * c.n, c.n, c.B, work.data());
+        },
+        &ctx, n_axes, nthreads);
+}
+
 // Full leopard-codec ExtendBlock: the O(n log n) FFT extension + the same
 // threaded NMT/data-root stage — the honest vs_leopard_cpu bench leg.
 void extend_block_leopard_cpu(const uint8_t* square, int k, int B,

@@ -122,6 +122,51 @@ def test_device_native_fft_pipelines_agree():
         assert np.array_equal(eds_dev, eds_fft), f"k={k}"
 
 
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_fft_erasure_decode_exact():
+    """The O(n log n) Forney-style erasure decode (leo_decode_axes) must
+    reproduce the codeword exactly for every mask size down to
+    exactly-k-received, at several square sizes — including all masks
+    at k=2 (the case that catches in-place/derivative mistakes)."""
+    import itertools
+
+    rng = np.random.default_rng(11)
+    # exhaustive at k=2
+    k, n = 2, 4
+    data = rng.integers(0, 256, (k, 8), dtype=np.uint8)
+    parity = gf256.encode_shares_ref(data, codec=gf256.CODEC_LEOPARD)
+    full = np.concatenate([data, parity], axis=0)
+    for keep_n in range(k, n + 1):
+        for keep in itertools.combinations(range(n), keep_n):
+            present = np.zeros(n, dtype=np.uint8)
+            present[list(keep)] = 1
+            buf = full.copy()
+            buf[present == 0] = 0
+            buf = np.ascontiguousarray(buf.reshape(1, n, 8))
+            ok = native.leo_decode_axes(buf, present.reshape(1, n))
+            assert ok[0] == 1 and np.array_equal(buf[0], full), keep
+    # random masks at larger sizes, incl. exactly-k received
+    for k in (8, 64, 128):
+        n = 2 * k
+        data = rng.integers(0, 256, (k, 64), dtype=np.uint8)
+        parity = gf256.encode_shares_ref(data, codec=gf256.CODEC_LEOPARD)
+        full = np.concatenate([data, parity], axis=0)
+        for n_keep in (k, k + 1, n - 1):
+            present = np.zeros(n, dtype=np.uint8)
+            present[rng.choice(n, size=n_keep, replace=False)] = 1
+            buf = full.copy()
+            buf[present == 0] = 0
+            buf = np.ascontiguousarray(buf.reshape(1, n, 64))
+            ok = native.leo_decode_axes(buf, present.reshape(1, n))
+            assert ok[0] == 1 and np.array_equal(buf[0], full), (k, n_keep)
+    # sub-threshold masks must be refused, untouched
+    present = np.zeros(2 * 8, dtype=np.uint8)
+    present[:7] = 1  # k=8 needs 8
+    buf = np.zeros((1, 16, 64), dtype=np.uint8)
+    ok = native.leo_decode_axes(buf, present.reshape(1, 16))
+    assert ok[0] == 0
+
+
 def test_repair_round_trip_under_leopard():
     rng = np.random.default_rng(9)
     k = 8

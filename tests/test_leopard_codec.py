@@ -261,6 +261,58 @@ def test_legacy_state_restores_lagrange():
     assert gf256.active_codec() == gf256.CODEC_LAGRANGE
 
 
+def test_lagrange_codec_chain_e2e():
+    """The NON-default codec must stay fully usable end-to-end: a chain
+    whose genesis pins lagrange-gf256 commits a PayForBlob and serves a
+    verifiable share proof (every other e2e in the suite now runs the
+    leopard default, so this is the lagrange chain's regression net)."""
+    from celestia_tpu.client.remote import RemoteNode
+    from celestia_tpu.client.signer import Signer
+    from celestia_tpu.da.blob import Blob
+    from celestia_tpu.da.namespace import Namespace
+    from celestia_tpu.node.server import NodeServer
+    from celestia_tpu.node.testnode import TestNode
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+
+    key = PrivateKey.from_seed(b"lagrange-e2e")
+    genesis = {
+        "chain_id": "lagrange-1",
+        "genesis_time_ns": 1_700_000_000_000_000_000,
+        "codec": gf256.CODEC_LAGRANGE,
+        "accounts": [
+            {"address": key.public_key().address().hex(), "balance": 10**12}
+        ],
+        "validators": [],
+    }
+    node = TestNode(chain_id="lagrange-1", genesis=genesis)
+    assert node.app.codec == gf256.CODEC_LAGRANGE
+    srv = NodeServer(node, block_interval_s=0.2)
+    srv.start()
+    r = None
+    try:
+        r = RemoteNode(srv.address, timeout_s=120)
+        signer = Signer(r, key)
+        blob = Blob(Namespace.v0(b"\x0c" * 10), b"lagrange chain blob")
+        res = signer.submit_pay_for_blob([blob])
+        assert res.code == 0, res.log
+        out = r.abci_query(
+            "custom/proof/share",
+            {"height": res.height, "start": 0, "end": 1},
+        )
+        # the codec-sensitive check: the proof must VERIFY against the
+        # block's data root (computed with lagrange parity on this chain)
+        from celestia_tpu.da.proof import ShareInclusionProof
+
+        proof = ShareInclusionProof.from_dict(out["proof"])
+        data_root = bytes.fromhex(out["data_root"])
+        assert proof.verify(data_root)
+        assert data_root == r.data_root(res.height)
+    finally:
+        if r is not None:
+            r.close()
+        srv.stop()
+
+
 def test_position_point_layout():
     """Leopard high-rate layout: parity occupies points [0, k), data
     [k, 2k) — position -> point is XOR with k."""

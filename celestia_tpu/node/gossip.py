@@ -230,6 +230,9 @@ class GossipEngine:
         self._pex_rr = 0  # round-robin cursor over peers for PEX
         self._catch_up_thread: Optional[threading.Thread] = None
         self._pull_backoff: Dict[str, float] = {}
+        # drops from links that no longer exist (evicted peers) — keeps
+        # dropped_total monotonic for monitoring deltas
+        self._dropped_closed = 0
         self.tick_s = tick_s
         self.base_timeout_s = base_timeout_s
         self.timeout_delta_s = timeout_delta_s
@@ -330,6 +333,8 @@ class GossipEngine:
                 self.peer_addrs.remove(addr)
             self._peer_failures.pop(addr, None)
             link = self._links.pop(addr, None)
+            if link is not None:
+                self._dropped_closed += link.dropped
         if link is not None:
             link._stop.set()  # worker exits on its own; never join here
             link._event.set()
@@ -414,6 +419,26 @@ class GossipEngine:
             pass  # engine rejects bad messages; a raise must not kill RPC
         self._flood(wire, exclude=sender)
         return True
+
+    def stats(self) -> dict:
+        """Operational snapshot for the status RPC: address-book size,
+        PEX-learned vs operator-configured split, and total messages
+        shed by per-peer backpressure (the observable form of the
+        drop-oldest queues)."""
+        with self._lock:
+            peers = len(self.peer_addrs)
+            static = len(self._static_peers & set(self.peer_addrs))
+            links = list(self._links.values())
+            dropped_closed = self._dropped_closed
+        return {
+            "peers": peers,
+            "static_peers": static,
+            "pex_learned": peers - static,
+            "fanout": self.fanout,
+            # monotonic: includes links already closed by eviction
+            "dropped_total": dropped_closed
+            + sum(link.dropped for link in links),
+        }
 
     def on_peer_exchange(self, sender: str, peers: List[str]) -> List[str]:
         """PEX inbound: learn the sender + its peers, return our list so

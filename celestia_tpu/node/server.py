@@ -90,6 +90,11 @@ class NodeService:
                     if getattr(node, "_validator_key", None)
                     else ""
                 ),
+                **(
+                    {"gossip": node.gossip_engine.stats()}
+                    if getattr(node, "gossip_engine", None) is not None
+                    else {}
+                ),
             }
         ).encode()
 

@@ -165,6 +165,15 @@ def test_pex_bootstrap_from_one_seed_with_bounded_fanout():
             assert len(e._peers_snapshot()) >= n - 2, (
                 f"PEX did not propagate: {e._peers_snapshot()}"
             )
+        # the status RPC surfaces the mesh's operational stats
+        r = RemoteNode(servers[1].address, timeout_s=30)
+        try:
+            st = r.status()
+            assert st["gossip"]["peers"] >= n - 2
+            assert st["gossip"]["fanout"] == 3
+            assert st["gossip"]["pex_learned"] >= n - 3
+        finally:
+            r.close()
         # kill the seed: > 2/3 power remains, mesh must keep committing
         engines[0].stop()
         servers[0].stop()

@@ -57,29 +57,12 @@ def _device_available() -> bool:
     subprocess and a timeout/-nonzero rc demotes the run to host-only
     legs (device: unavailable, exit 0) instead of zeroing the round's
     evidence (VERDICT r4 weak #1)."""
-    code = (
-        "import jax\n"
-        "ds = jax.devices()\n"
-        "assert ds\n"
-        "print('BENCH_PROBE_OK', ds[0].platform)\n"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, timeout=PROBE_TIMEOUT_S,
-        )
-    except subprocess.TimeoutExpired:
-        return False
-    if proc.returncode != 0:
-        return False
-    for line in proc.stdout.decode("utf-8", "replace").splitlines():
-        if line.startswith("BENCH_PROBE_OK"):
-            platform = line.split()[-1].lower()
-            # a silent CPU fallback is NOT a device: the k=128 programs
-            # take minutes to compile on XLA CPU (driver timeout) and
-            # the numbers would be mislabeled as device figures
-            return platform not in ("cpu", "bench_probe_ok")
-    return False
+    # a silent CPU fallback is NOT a device: the k=128 programs take
+    # minutes to compile on XLA CPU (driver timeout) and the numbers
+    # would be mislabeled as device figures — hence accept_cpu=False
+    from celestia_tpu.utils.device import backend_available
+
+    return backend_available(timeout_s=PROBE_TIMEOUT_S, accept_cpu=False)
 
 
 def _chain_fn(k: int, r: int, batch: int = 0):

@@ -252,6 +252,45 @@ def cmd_start(args) -> int:
         valset = json.loads(Path(args.bft_valset).read_text())
         node.enable_bft(valset)
         log.info("BFT consensus enabled", validators=len(valset))
+    # Pre-warm the device extension programs BEFORE serving: the block
+    # producer holds the service lock across the first extension of each
+    # square size, and a cold TPU compile there (~20-40 s) would stall
+    # every RPC past its deadline.  Sizes are configurable; warming at
+    # boot trades startup seconds for never stalling a live block.
+    raw_sizes = str(getattr(args, "warm_squares", "1,2,4"))
+    try:
+        warm_sizes = [int(s) for s in raw_sizes.split(",") if s.strip()]
+    except ValueError:
+        raise SystemExit(f"--warm-squares must be comma-separated ints: {raw_sizes!r}")
+    for s in warm_sizes:
+        if not 1 <= s <= 128 or s & (s - 1):
+            raise SystemExit(
+                f"--warm-squares sizes must be powers of two in [1, 128], got {s}"
+            )
+    if warm_sizes:
+        from celestia_tpu.utils.device import backend_available
+
+        if not backend_available(timeout_s=120.0, accept_cpu=True):
+            # a dead tunnel HANGS backend init — probed in a child so the
+            # node still starts and serves; first extensions will compile
+            # lazily if/when the backend returns
+            log.warn("device backend unreachable; skipping program warm-up")
+            warm_sizes = []
+    if warm_sizes:
+        import numpy as _np
+
+        from celestia_tpu.da import dah as _dah
+
+        t_warm = time.time()
+        for s in warm_sizes:
+            _dah.extend_and_header(
+                _np.zeros((s, s, 512), dtype=_np.uint8)
+            )
+        log.info(
+            "device programs warmed",
+            sizes=",".join(map(str, warm_sizes)),
+            seconds=round(time.time() - t_warm, 1),
+        )
     server = NodeServer(
         node,
         address=cfg.grpc.address,
@@ -1096,6 +1135,11 @@ def build_parser() -> argparse.ArgumentParser:
              "validator gRPC addresses; consensus messages flood "
              "directly between validators with own round timers — no "
              "relay needed",
+    )
+    sp.add_argument(
+        "--warm-squares", default="1,2,4",
+        help="square sizes whose device programs compile at boot instead "
+             "of stalling the first live block ('' disables)",
     )
     sp.set_defaults(fn=cmd_start)
 

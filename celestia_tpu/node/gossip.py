@@ -332,12 +332,17 @@ class GossipEngine:
             if addr in self.peer_addrs:
                 self.peer_addrs.remove(addr)
             self._peer_failures.pop(addr, None)
+            self._pull_backoff.pop(addr, None)
             link = self._links.pop(addr, None)
             if link is not None:
                 self._dropped_closed += link.dropped
         if link is not None:
             link._stop.set()  # worker exits on its own; never join here
             link._event.set()
+            # drop the cached catch-up client too: an evicted address
+            # must not keep an open channel/fd behind (its cost really
+            # is "bounded slots for a bounded time")
+            self._drop_pull_client(addr)
             self.log.warn("evicted unresponsive PEX-learned peer", peer=addr)
 
     def _flood(self, wire: dict, exclude: Optional[str] = None) -> None:

@@ -1,0 +1,45 @@
+"""Device-backend probing.
+
+A dead accelerator tunnel can make JAX backend init HANG for minutes
+rather than raise (observed live in round 5), so anything that would
+touch the backend at a time-sensitive moment probes it in a CHILD
+process with a timeout first.  Used by bench.py (which rejects a silent
+CPU fallback — its numbers must be device numbers) and the node CLI's
+boot-time program warming (which accepts CPU: a CPU-backed node is a
+legitimate deployment, e.g. the test meshes).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+
+def backend_available(
+    timeout_s: float = 120.0, accept_cpu: bool = True
+) -> bool:
+    """True when `jax.devices()` initializes within the timeout (in a
+    subprocess — a hang or crash there cannot take the caller down).
+    With accept_cpu=False a CPU-only backend counts as unavailable."""
+    code = (
+        "import jax\n"
+        "ds = jax.devices()\n"
+        "assert ds\n"
+        "print('PROBE_OK', ds[0].platform)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    if proc.returncode != 0:
+        return False
+    for line in proc.stdout.decode("utf-8", "replace").splitlines():
+        if line.startswith("PROBE_OK"):
+            platform = line.split()[-1].lower()
+            if platform in ("cpu", "probe_ok") and not accept_cpu:
+                return False
+            return True
+    return False

@@ -36,3 +36,20 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running process-level e2e tests"
     )
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop compiled XLA executables at module boundaries.
+
+    jaxlib's CPU plugin segfaults inside backend_compile_and_load once
+    enough executables accumulate in one long-lived process (observed at
+    ~65% of a full-suite run after ADR-012 doubled the per-size program
+    variants; same crash family as the executable.serialize() note
+    above).  Clearing per module keeps the live set small; the few extra
+    small-k recompiles are seconds each."""
+    yield
+    jax.clear_caches()

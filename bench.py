@@ -149,6 +149,15 @@ def _e2e_extend_ms(k: int):
     return float(np.median(times))
 
 
+def _cpu_threads() -> int:
+    """The ACTUAL host worker count the CPU legs ran with (the pool
+    size: --cpu-threads / CELESTIA_TPU_CPU_THREADS / os.cpu_count) —
+    r05 recorded os.cpu_count() while the legs threaded independently."""
+    from celestia_tpu.utils import hostpool
+
+    return hostpool.cpu_threads()
+
+
 def _cpu_ms(k: int):
     """Native threaded C++ pipeline at full size (no extrapolation)."""
     from celestia_tpu.utils import native
@@ -160,7 +169,7 @@ def _cpu_ms(k: int):
     times = []
     for _ in range(3):
         t0 = time.time()
-        native.extend_block_cpu(sq, nthreads=0)
+        native.extend_block_cpu(sq)
         times.append((time.time() - t0) * 1000.0)
     return float(np.median(times))
 
@@ -181,18 +190,49 @@ def _leopard_cpu_ms(k: int):
     sq = np.ascontiguousarray(
         rng.integers(0, 256, (k, k, 512), dtype=np.uint8)
     )
-    native.extend_block_leopard_cpu(sq, nthreads=0)  # warm tables
+    native.extend_block_leopard_cpu(sq)  # warm tables
     times = []
     for _ in range(3):
         t0 = time.time()
-        native.extend_block_leopard_cpu(sq, nthreads=0)
+        native.extend_block_leopard_cpu(sq)
         times.append((time.time() - t0) * 1000.0)
     ext_times = []
     for _ in range(3):
         t0 = time.time()
-        native.leo_extend_square(sq, nthreads=0)
+        native.leo_extend_square(sq)
         ext_times.append((time.time() - t0) * 1000.0)
     return float(np.median(times)), float(np.median(ext_times))
+
+
+def _leopard_scaling_ms(k: int, pool_ms: float = None):
+    """Thread-scaling of the full leopard host pipeline at 1/2/N worker
+    threads (N = the pool size) — the evidence that the multi-threaded
+    host DA path actually fans out.  Returns {"t1": ms, "t2": ms,
+    "tN": ms} (keys deduplicated when N <= 2).  ``pool_ms`` reuses the
+    pool-width median _leopard_cpu_ms already measured instead of
+    re-running the full pipeline three more times."""
+    from celestia_tpu.utils import native
+
+    if not native.available():
+        return None
+    rng = np.random.default_rng(1)
+    sq = np.ascontiguousarray(
+        rng.integers(0, 256, (k, k, 512), dtype=np.uint8)
+    )
+    native.extend_block_leopard_cpu(sq, nthreads=1)  # warm tables
+    out = {}
+    n = _cpu_threads()
+    for t in sorted({1, min(2, n), n}):
+        if t == n and pool_ms is not None:
+            out[f"t{t}"] = round(float(pool_ms), 1)
+            continue
+        times = []
+        for _ in range(3):
+            t0 = time.time()
+            native.extend_block_leopard_cpu(sq, nthreads=t)
+            times.append((time.time() - t0) * 1000.0)
+        out[f"t{t}"] = round(float(np.median(times)), 1)
+    return out
 
 
 def _repair_ms(k: int):
@@ -208,7 +248,7 @@ def _repair_ms(k: int):
     rng = np.random.default_rng(3)
     sq = rng.integers(0, 256, (k, k, 512), dtype=np.uint8)
     if native.available():
-        eds, roots, _ = native.extend_block_cpu(sq, nthreads=0)
+        eds, roots, _ = native.extend_block_cpu(sq)
     else:
         eds = np.asarray(rs.extend_square(sq))
         from celestia_tpu.ops import nmt as nmt_ops
@@ -452,7 +492,7 @@ def _host_repair_ms(k: int):
         return None
     rng = np.random.default_rng(3)
     sq = rng.integers(0, 256, (k, k, 512), dtype=np.uint8)
-    eds, roots, _ = native.extend_block_leopard_cpu(sq, nthreads=0)
+    eds, roots, _ = native.extend_block_leopard_cpu(sq)
     rr, cc = roots[: 2 * k], roots[2 * k :]
     avail = rng.random((2 * k, 2 * k)) >= 0.25
     damaged = eds.copy()
@@ -525,7 +565,7 @@ def _host_only_main():
     if cpu_ms is not None:
         extras["cpu_leg"] = "table_gf_cpu"
         extras[f"extend_block_{K}_table_gf_cpu_ms"] = round(cpu_ms, 1)
-        extras["cpu_threads"] = os.cpu_count()
+        extras["cpu_threads"] = _cpu_threads()
     try:
         leo_ms, leo_ext_ms = _leopard_cpu_ms(K)
         if leo_ms is not None:
@@ -535,6 +575,14 @@ def _host_only_main():
             cpu_ms = leo_ms
     except Exception as e:
         extras["leopard_error"] = repr(e)[:200]
+    try:
+        scaling = _leopard_scaling_ms(
+            K, extras.get(f"extend_block_{K}_leopard_cpu_ms")
+        )
+        if scaling is not None:
+            extras["extend_block_thread_scaling_ms"] = scaling
+    except Exception as e:
+        extras["scaling_error"] = repr(e)[:200]
     try:
         extras["filter_512_pfb_ms"] = round(_filter_txs_ms(512), 1)
     except Exception as e:
@@ -592,7 +640,7 @@ def main():
     cpu_ms = _cpu_ms(k)
     if cpu_ms is not None:
         extras[f"extend_block_{k}_table_gf_cpu_ms"] = round(cpu_ms, 1)
-        extras["cpu_threads"] = os.cpu_count()
+        extras["cpu_threads"] = _cpu_threads()
     try:
         leo_ms, leo_ext_ms = _leopard_cpu_ms(k)
     except Exception as e:  # never let a CPU leg kill the device evidence
@@ -608,6 +656,12 @@ def main():
         cpu_ms = leo_ms  # vs_baseline compares against the leopard leg
     elif cpu_ms is not None:
         extras["cpu_leg"] = "table_gf_cpu"
+    try:
+        scaling = _leopard_scaling_ms(k, leo_ms)
+        if scaling is not None:
+            extras["extend_block_thread_scaling_ms"] = scaling
+    except Exception as e:
+        extras["scaling_error"] = repr(e)[:200]
     e2e_ms = _e2e_extend_ms(k)
     extras[f"extend_block_{k}_e2e_single_call_ms"] = round(e2e_ms, 2)
     extras["transfer_overhead_ms"] = round(e2e_ms - device_ms, 2)

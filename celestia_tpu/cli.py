@@ -1097,6 +1097,12 @@ def cmd_blocktime(args) -> int:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="celestia-tpu")
     p.add_argument("--home", default=None, help="node home directory")
+    p.add_argument(
+        "--cpu-threads", type=int, default=None, metavar="N",
+        help="host worker threads for the CPU DA pipeline (native "
+             "NMT/SHA hashing, erasure decode, repair fallback); "
+             "default: CELESTIA_TPU_CPU_THREADS or os.cpu_count()",
+    )
     sub = p.add_subparsers(dest="cmd", required=True)
 
     sp = sub.add_parser("init", help="initialise a node home")
@@ -1374,6 +1380,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if getattr(args, "cpu_threads", None) is not None:
+        from celestia_tpu.utils import hostpool
+
+        try:
+            hostpool.set_cpu_threads(args.cpu_threads)
+        except ValueError as e:
+            raise SystemExit(f"--cpu-threads: {e}")
     return args.fn(args)
 
 

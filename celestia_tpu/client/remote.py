@@ -27,10 +27,23 @@ class RemoteError(RuntimeError):
 class RemoteNode:
     """Client handle to a celestia-tpu node's gRPC service."""
 
+    # Hard transport bound on any single response (ADVICE r5 state-sync
+    # DoS): grpc's own default is 4 MiB but IMPLICIT — pin it explicitly
+    # so a future channel tweak cannot silently remove the only layer
+    # that stops a hostile peer flooding an unbounded message.  Every
+    # legitimate RPC (snapshot chunks are <= 1 MiB on the wire, 2 MiB as
+    # hex) fits comfortably.
+    MAX_RECV_BYTES = 4 * 1024 * 1024
+
     def __init__(self, address: str, timeout_s: float = 30.0):
         self.address = address
         self.timeout_s = timeout_s
-        self._channel = grpc.insecure_channel(address)
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_receive_message_length", self.MAX_RECV_BYTES)
+            ],
+        )
         self._methods: dict = {}
         status = self.status()
         self.chain_id = status["chain_id"]
@@ -216,7 +229,26 @@ class RemoteNode:
         out = self._call_json(
             "SnapshotChunk", {"height": height, "format": fmt, "idx": idx}
         )
-        return bytes.fromhex(out["data"]) if out.get("found") else None
+        if not out.get("found"):
+            return None
+        data = out["data"]
+        # size-bound the HEX payload before decoding.  The transport cap
+        # (MAX_RECV_BYTES on the channel — the layer that actually stops
+        # an arbitrarily large response from being buffered) has already
+        # bounded the message; this check catches a hostile-but-small
+        # oversized chunk early, with the precise SnapshotLimitError the
+        # sync engine uses to back the peer off (ADVICE r5)
+        from celestia_tpu.node.snapshots import (
+            MAX_WIRE_CHUNK_BYTES,
+            SnapshotLimitError,
+        )
+
+        if len(data) > 2 * MAX_WIRE_CHUNK_BYTES:
+            raise SnapshotLimitError(
+                f"snapshot chunk {idx} hex payload is {len(data)} chars "
+                f"(cap {2 * MAX_WIRE_CHUNK_BYTES})"
+            )
+        return bytes.fromhex(data)
 
     def wait_for_height(self, h: int, timeout_s: float = 60.0) -> None:
         deadline = time.time() + timeout_s

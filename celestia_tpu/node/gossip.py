@@ -665,14 +665,15 @@ class GossipEngine:
         for addr in peers:
             cli = self._pull_client(addr)
             if cli is None:
-                self._pull_backoff[addr] = _time.time() + 10.0
+                self._set_pull_backoff(addr, 10.0)
                 continue
             try:
                 best = max(best, int(cli.status().get("height", 0)))
-                self._pull_backoff.pop(addr, None)
+                with self._lock:
+                    self._pull_backoff.pop(addr, None)
             except Exception:
                 self._drop_pull_client(addr)
-                self._pull_backoff[addr] = _time.time() + 10.0
+                self._set_pull_backoff(addr, 10.0)
         if best <= self.node.height:
             with self._lock:
                 # nobody is actually ahead: the hint was noise
@@ -684,7 +685,7 @@ class GossipEngine:
                 return
             cli = self._pull_client(addr)
             if cli is None:
-                self._pull_backoff[addr] = _time.time() + 10.0
+                self._set_pull_backoff(addr, 10.0)
                 continue
             try:
                 while self.node.height < target:
@@ -694,16 +695,53 @@ class GossipEngine:
                         # offline longer than the decided-log window
                         # state-syncs from a served snapshot, then
                         # resumes certificate replay from there
-                        if not self._try_state_sync(cli):
+                        if not self._try_state_sync(cli, addr):
                             break
                         continue
                     if not self.node.bft_catchup(d)[0]:
                         break
             except Exception:
                 self._drop_pull_client(addr)
-                self._pull_backoff[addr] = _time.time() + 10.0
+                self._set_pull_backoff(addr, 10.0)
 
-    def _try_state_sync(self, cli) -> bool:
+    def _set_pull_backoff(self, addr: str, seconds: float) -> None:
+        """Cool a peer down, under the engine lock — _peer_failed (link
+        worker threads) mutates the same dict, so the catch-up worker
+        must use the same discipline (ADVICE r5)."""
+        with self._lock:
+            self._pull_backoff[addr] = _time.time() + seconds
+
+    def _fetch_snapshot_chunks(self, cli, meta: dict) -> list:
+        """Download one snapshot's chunks with per-chunk resource bounds
+        (ADVICE r5): every chunk is size-capped BEFORE its hash check —
+        the writer never produces a chunk above MAX_WIRE_CHUNK_BYTES, so
+        an oversized payload is hostile and raises SnapshotLimitError —
+        and corrupt chunks abort on first sight, not after the whole
+        download."""
+        from celestia_tpu.node.snapshots import (
+            MAX_WIRE_CHUNK_BYTES,
+            SnapshotLimitError,
+        )
+
+        n_chunks = int(meta["chunks"])
+        chunks = []
+        for i in range(n_chunks):
+            c = cli.snapshot_chunk(
+                int(meta["height"]), int(meta.get("format", 1)), i
+            )
+            if c is None:
+                raise ValueError(f"peer missing chunk {i}")
+            if len(c) > MAX_WIRE_CHUNK_BYTES:
+                raise SnapshotLimitError(
+                    f"chunk {i} is {len(c)} bytes "
+                    f"(cap {MAX_WIRE_CHUNK_BYTES})"
+                )
+            if hashlib.sha256(c).hexdigest() != meta["chunk_hashes"][i]:
+                raise ValueError(f"chunk {i} corrupt in transfer")
+            chunks.append(c)
+        return chunks
+
+    def _try_state_sync(self, cli, addr: str = "") -> bool:
         """Network state-sync (VERDICT r4 #4; the reference serves
         snapshots to syncing peers, root.go:227-243 +
         default_overrides.go:296-297).  Trust order matters: the
@@ -711,7 +749,10 @@ class GossipEngine:
         2/3-signed, committing to the snapshot's app hash via
         prev_app_hash) is verified BEFORE any chunk is applied — a
         malicious snapshot can never swap state in."""
-        from celestia_tpu.node.snapshots import SnapshotStore
+        from celestia_tpu.node.snapshots import (
+            SnapshotLimitError,
+            SnapshotStore,
+        )
 
         try:
             metas = cli.snapshot_list()
@@ -720,7 +761,12 @@ class GossipEngine:
         metas = [
             m for m in metas if int(m.get("height", 0)) > self.node.height
         ]
-        for meta in sorted(metas, key=lambda m: -int(m["height"])):
+        # the metas LIST is peer-supplied and unbounded: only try the few
+        # newest (honest servers keep ~2 recent snapshots), so one peer
+        # cannot chain hundreds of 512 MiB download attempts
+        metas = sorted(metas, key=lambda m: -int(m.get("height", 0)))[:3]
+        for meta in metas:
+            downloaded = False
             try:
                 anchor = cli.bft_decided(int(meta["height"]) + 1)
                 if anchor is None:
@@ -735,26 +781,18 @@ class GossipEngine:
                 n_chunks = int(meta["chunks"])
                 # the chunk COUNT is peer-supplied and not covered by the
                 # anchor certificate: bound it so one peer cannot force
-                # unbounded download/memory per sync attempt (1 MiB
-                # chunks -> 512 MiB cap, far above any real app state)
+                # unbounded download/memory per sync attempt (with the
+                # per-chunk byte bound in _fetch_snapshot_chunks this
+                # caps a sync attempt at 512 MiB on the wire, far above
+                # any real app state)
                 if n_chunks > 512 or len(meta.get("chunk_hashes", [])) != (
                     n_chunks
                 ):
                     raise ValueError(
                         f"implausible snapshot shape: {n_chunks} chunks"
                     )
-                chunks = []
-                for i in range(n_chunks):
-                    c = cli.snapshot_chunk(
-                        int(meta["height"]), int(meta.get("format", 1)), i
-                    )
-                    if c is None:
-                        raise ValueError(f"peer missing chunk {i}")
-                    if hashlib.sha256(c).hexdigest() != meta["chunk_hashes"][i]:
-                        # abort on FIRST corrupt chunk, not after the
-                        # whole download
-                        raise ValueError(f"chunk {i} corrupt in transfer")
-                    chunks.append(c)
+                chunks = self._fetch_snapshot_chunks(cli, meta)
+                downloaded = True
                 data = SnapshotStore.assemble(meta, chunks)
                 self.node.adopt_state_sync(meta, data)
                 self.node.bft_catchup(anchor)  # apply the anchor block
@@ -763,7 +801,26 @@ class GossipEngine:
                     height=meta["height"],
                 )
                 return True
+            except SnapshotLimitError as e:
+                # resource-bound violation: no honest peer serves this —
+                # abort the whole sync attempt and cool the peer down
+                # much longer than a transient failure
+                self.log.warn(
+                    "state-sync peer exceeded resource bounds; backing off",
+                    err=str(e)[:200], peer=addr,
+                )
+                if addr:
+                    self._set_pull_backoff(addr, 60.0)
+                return False
             except Exception as e:
                 self.log.warn("state-sync attempt failed", err=str(e)[:200])
+                if downloaded:
+                    # the peer served a COMPLETE, hash-consistent snapshot
+                    # that still failed to apply (bad app hash / state):
+                    # hostile or corrupt — don't burn another full
+                    # download on its next meta this attempt
+                    if addr:
+                        self._set_pull_backoff(addr, 60.0)
+                    return False
                 continue
         return False

@@ -26,6 +26,19 @@ from typing import List, Optional
 SNAPSHOT_FORMAT = 1
 CHUNK_BYTES = 1 << 20
 
+# DoS bounds on PEER-SUPPLIED snapshot data (ADVICE r5): the writer
+# never produces a chunk above CHUNK_BYTES, so anything larger on the
+# wire is hostile; the decompressed state payload is capped so a zlib
+# bomb cannot exhaust memory before the app-hash check would fail.
+MAX_WIRE_CHUNK_BYTES = CHUNK_BYTES
+MAX_STATE_BYTES = 1 << 30  # 1 GiB decompressed, far above any real state
+
+
+class SnapshotLimitError(ValueError):
+    """A peer-supplied snapshot exceeded a resource bound (oversized
+    chunk or decompression blow-up) — abort the sync and back off the
+    peer; no honest snapshot trips these."""
+
 
 @dataclass(frozen=True)
 class SnapshotInfo:
@@ -168,14 +181,33 @@ class SnapshotStore:
         """Verify fetched chunks against the metadata hashes and decode
         the state payload — the restore half of the wire protocol.  The
         hashes only catch transfer corruption; TRUST comes from the app
-        hash + commit-certificate checks done by the caller."""
+        hash + commit-certificate checks done by the caller.  Resource
+        bounds (chunk size, decompressed total) are enforced HERE so a
+        malicious snapshot raises :class:`SnapshotLimitError` before it
+        can exhaust memory."""
         if len(chunks) != meta["chunks"]:
             raise ValueError("chunk count mismatch")
         for i, chunk in enumerate(chunks):
+            if len(chunk) > MAX_WIRE_CHUNK_BYTES:
+                raise SnapshotLimitError(
+                    f"snapshot chunk {i} is {len(chunk)} bytes "
+                    f"(cap {MAX_WIRE_CHUNK_BYTES})"
+                )
             got = hashlib.sha256(chunk).hexdigest()
             if got != meta["chunk_hashes"][i]:
                 raise ValueError(f"snapshot chunk {i} corrupt in transfer")
-        return json.loads(zlib.decompress(b"".join(chunks)))
+        # capped streaming decompression: never materialize more than
+        # MAX_STATE_BYTES of output no matter what the stream claims
+        d = zlib.decompressobj()
+        raw = d.decompress(b"".join(chunks), MAX_STATE_BYTES + 1)
+        if len(raw) > MAX_STATE_BYTES:
+            raise SnapshotLimitError(
+                f"snapshot state exceeds the {MAX_STATE_BYTES}-byte "
+                "decompression cap"
+            )
+        if d.unconsumed_tail or d.unused_data or not d.eof:
+            raise ValueError("snapshot payload is not one zlib stream")
+        return json.loads(raw)
 
     def restore_app(self, info: SnapshotInfo, **app_kwargs):
         """Build a fresh App from a snapshot; verifies the app hash."""

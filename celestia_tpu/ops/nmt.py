@@ -103,6 +103,81 @@ def eds_nmt_roots(eds: jnp.ndarray) -> jnp.ndarray:
     return nmt_roots(eds_prefixed_leaves(eds))
 
 
+def _nmt_roots_np_batch(leaves: np.ndarray) -> np.ndarray:
+    """Host reduction of a batch of NMTs: uint8[T, n, L] -> uint8[T, 90].
+
+    Mirror of :func:`nmt_roots` in numpy — the no-native fallback of
+    :func:`eds_nmt_roots_host`.  Hashing runs SERIALLY (nthreads=1):
+    this executes inside a pool worker, and fanning out again onto the
+    same executor would deadlock it (all workers blocked on futures only
+    they could run)."""
+    from celestia_tpu.ops.sha256 import sha256_batch_host
+
+    T, n, L = leaves.shape
+    ns = leaves[:, :, :NAMESPACE_SIZE]
+    prefix = np.zeros((T, n, 1), dtype=np.uint8)
+    h = sha256_batch_host(
+        np.concatenate([prefix, leaves], axis=-1).reshape(T * n, L + 1),
+        nthreads=1,
+    ).reshape(T, n, 32)
+    nodes = np.concatenate([ns, ns, h], axis=-1)
+    while nodes.shape[1] > 1:
+        left, right = nodes[:, 0::2], nodes[:, 1::2]
+        l_max = left[..., NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
+        r_min = right[..., :NAMESPACE_SIZE]
+        r_max = right[..., NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
+        r_is_parity = np.all(r_min == _PARITY_NS, axis=-1, keepdims=True)
+        max_ns = np.where(r_is_parity, l_max, r_max)
+        one = np.ones(left.shape[:-1] + (1,), dtype=np.uint8)
+        h = sha256_batch_host(
+            np.concatenate([one, left, right], axis=-1).reshape(
+                -1, 1 + 2 * NMT_DIGEST_SIZE
+            ),
+            nthreads=1,
+        ).reshape(left.shape[:-1] + (32,))
+        nodes = np.concatenate(
+            [left[..., :NAMESPACE_SIZE], max_ns, h], axis=-1
+        )
+    return nodes[:, 0]
+
+
+def eds_nmt_roots_host(eds: np.ndarray, nthreads=None) -> np.ndarray:
+    """All 4k NMT axis roots on the HOST worker pool (no device, no XLA
+    compile): uint8[2k, 2k, B] -> uint8[2, 2k, 90].
+
+    The 4k trees are embarrassingly parallel; the native C++ entry
+    shards them across the pool, and the numpy fallback shards
+    tree-chunks across the same pool.  Byte-identical to
+    :func:`eds_nmt_roots` (pinned by tests/test_sha_nmt.py and the
+    thread-scaling tests in tests/test_leopard_codec.py)."""
+    from celestia_tpu.utils import hostpool, native
+
+    eds = np.ascontiguousarray(eds, dtype=np.uint8)
+    n2 = eds.shape[0]
+    if native.available():
+        return native.eds_nmt_roots(eds, nthreads=nthreads).reshape(
+            2, n2, NMT_DIGEST_SIZE
+        )
+    # numpy fallback: build the prefixed leaves, then reduce tree-chunks
+    # on the shared pool
+    k = n2 // 2
+    own_ns = eds[..., :NAMESPACE_SIZE]
+    parity = np.broadcast_to(_PARITY_NS, own_ns.shape)
+    r = np.arange(n2)
+    in_q0 = (r[:, None] < k) & (r[None, :] < k)
+    prefix = np.where(in_q0[..., None], own_ns, parity)
+    rows = np.concatenate([prefix, eds], axis=-1)
+    trees = np.concatenate([rows, rows.transpose(1, 0, 2)], axis=0)
+    workers = nthreads if nthreads is not None else hostpool.cpu_threads()
+    workers = max(1, min(int(workers), trees.shape[0]))
+    bounds = np.linspace(0, trees.shape[0], workers + 1).astype(int)
+    chunks = hostpool.run_sharded(
+        lambda t: _nmt_roots_np_batch(trees[bounds[t] : bounds[t + 1]]),
+        range(workers),
+    )
+    return np.concatenate(chunks, axis=0).reshape(2, n2, NMT_DIGEST_SIZE)
+
+
 def empty_root_np() -> np.ndarray:
     """EmptyRoot: zeros ns range + sha256 of the empty string."""
     import hashlib

@@ -465,13 +465,16 @@ def repair_square_device(
 # ---------------------------------------------------------------------------
 
 
-def _gf_matmul_axes_host(D: np.ndarray, X: np.ndarray) -> np.ndarray:
+def _gf_matmul_axes_host(
+    D: np.ndarray, X: np.ndarray, nthreads=None
+) -> np.ndarray:
     """out[i] = D[i] x X[i] over GF(256): threaded native C++ when
-    available, vectorized numpy log-table fallback otherwise."""
+    available (sharded across the host pool), vectorized numpy log-table
+    fallback otherwise."""
     from celestia_tpu.utils import native
 
     if native.available():
-        return native.gf_matmul_axes(D, X)
+        return native.gf_matmul_axes(D, X, nthreads=nthreads)
     exp, log = gf256.field_tables()  # active codec's representation
     n, R, k = D.shape
     B = X.shape[2]
@@ -504,6 +507,7 @@ def repair_square(
     available: np.ndarray,
     row_roots: np.ndarray = None,
     col_roots: np.ndarray = None,
+    nthreads: int = None,
 ) -> np.ndarray:
     """Reconstruct a full EDS from a partial one (rsmt2d.Repair parity).
 
@@ -526,10 +530,23 @@ def repair_square(
     originally-available cell must match what was provided (this also
     catches inconsistent fully-available axes that need no solving), then
     checked against the committed roots when supplied.
+
+    ``nthreads`` (None = the process pool size, ``--cpu-threads``) fans
+    the per-phase decode, the re-extension and the NMT root verification
+    out over the host worker pool: within a phase every solvable axis is
+    independent, so the decode batch, the verify extension and the 4k
+    root trees all shard cleanly.  Threaded and single-threaded repairs
+    are byte-identical (tests/test_leopard_codec.py).
     """
     from celestia_tpu.utils import native as _nat
 
-    original_eds = np.array(eds, dtype=np.uint8, copy=True)
+    # LAZY snapshot of the provided shares: the leopard decoder only
+    # ever writes ERASED cells, so provided bytes survive in eds and the
+    # final eds == recomputed check subsumes the provided-share check.
+    # Only the generic matrix path overwrites whole axes (recomputed
+    # bytes over provided ones) — it snapshots before its first write.
+    # Skipping the eager copy saves a full square memcpy per repair.
+    original_eds: np.ndarray = None
     eds = np.array(eds, dtype=np.uint8, copy=True)
     avail = np.array(available, dtype=bool, copy=True)
     n2 = eds.shape[0]
@@ -561,23 +578,43 @@ def repair_square(
                 # (native leo_decode_axes, Forney over the novel basis)
                 # — ~0.3 ms/axis at k=128 vs several ms for the
                 # matrix path; bit-identical (tests/test_leopard_codec)
+                if axis == 0 and bool((counts >= k).all()):
+                    # fast host path (the common honest-DAS shape: every
+                    # row has >= k cells): decode IN PLACE on the whole
+                    # contiguous square — rows ARE the axes, complete
+                    # rows are no-ops inside the decoder — skipping the
+                    # ~2x33 MiB gather/scatter the index path pays
+                    ok = _nat.leo_decode_axes(
+                        eds, avail.astype(np.uint8), nthreads=nthreads
+                    )
+                    if not ok.all():
+                        raise RuntimeError(
+                            "leo_decode_axes rejected a solvable axis"
+                        )
+                    avail[:, :] = True
+                    progress = True
+                    continue
                 block = np.ascontiguousarray(data[idxs])
                 ok = _nat.leo_decode_axes(
-                    block, mask[idxs].astype(np.uint8)
+                    block, mask[idxs].astype(np.uint8), nthreads=nthreads
                 )
                 if not ok.all():  # solvable==True guarantees >= k rows
                     raise RuntimeError("leo_decode_axes rejected a solvable axis")
                 decoded = block
             else:
                 # generic path: one Lagrange decode matrix per axis
-                # (vectorized) + one threaded native GF matmul
+                # (vectorized) + one threaded native GF matmul.  This
+                # path overwrites whole axes, so snapshot the provided
+                # bytes first (still intact in eds at this point)
+                if original_eds is None:
+                    original_eds = eds.copy()
                 order = np.argsort(~mask[idxs], axis=1, kind="stable")
                 known_idx = np.sort(order[:, :k], axis=1)  # [n_axes, k]
                 D = gf256.decode_matrices_batch(known_idx.astype(np.uint8), k)
                 X = np.take_along_axis(
                     data[idxs], known_idx[:, :, None], axis=1
                 )  # [n_axes, k, B]
-                decoded = _gf_matmul_axes_host(D, X)  # [n_axes, 2k, B]
+                decoded = _gf_matmul_axes_host(D, X, nthreads)  # [n_axes, 2k, B]
             if axis == 0:
                 eds[idxs] = decoded
                 avail[idxs] = True
@@ -593,8 +630,15 @@ def repair_square(
     # Byzantine check: the completed square must be the unique codeword
     # extending its Q0, and every share the caller actually provided must
     # agree with it.  (rsmt2d returns ErrByzantine from Repair here.)
+    # When no generic pass ran, provided bytes are still in place in eds
+    # (the leopard decoder never touches received cells), so the
+    # provided-share check below is subsumed by eds == recomputed.
     orig_avail = np.asarray(available, dtype=bool)
-    provided = np.array(original_eds, dtype=np.uint8, copy=False)
+    provided = (
+        np.array(original_eds, dtype=np.uint8, copy=False)
+        if original_eds is not None
+        else eds
+    )
     # Repair is a DAS/light-client operation: verify on the host (threaded
     # native pipeline, bit-identical to the device kernels) so repairing a
     # square never requires an accelerator or pays a cold device compile;
@@ -612,14 +656,14 @@ def repair_square(
         # work than the table method at k=128)
         if use_leo:
             recomputed, native_roots, _ = _native.extend_block_leopard_cpu(
-                eds[:k, :k], nthreads=0
+                eds[:k, :k], nthreads=nthreads
             )
         else:
             recomputed, native_roots, _ = _native.extend_block_cpu(
-                eds[:k, :k], nthreads=0
+                eds[:k, :k], nthreads=nthreads
             )
     elif use_leo:
-        recomputed = _native.leo_extend_square(eds[:k, :k])
+        recomputed = _native.leo_extend_square(eds[:k, :k], nthreads=nthreads)
     elif use_native:
         recomputed = _native.rs_extend_square(eds[:k, :k])
     else:
@@ -644,7 +688,8 @@ def repair_square(
         else:
             from celestia_tpu.ops import nmt as nmt_ops
 
-            roots = np.asarray(nmt_ops.eds_nmt_roots(eds))
+            # pooled host reduction (numpy fallback when native is absent)
+            roots = nmt_ops.eds_nmt_roots_host(eds, nthreads=nthreads)
         for name, axis_roots, got in (
             ("row", row_roots, roots[0]),
             ("col", col_roots, roots[1]),

@@ -134,3 +134,34 @@ def sha256_np(msgs: np.ndarray) -> np.ndarray:
     """Convenience host entry: numpy in/out, jitted per input rank."""
     msgs = np.asarray(msgs, dtype=np.uint8)
     return np.asarray(_sha256_jit(msgs.ndim)(jnp.asarray(msgs)))
+
+
+def sha256_batch_host(msgs: np.ndarray, nthreads=None) -> np.ndarray:
+    """Batched SHA-256 on the HOST worker pool: uint8[n, L] -> uint8[n, 32].
+
+    The host-regime counterpart of :func:`sha256` — native threaded
+    SHA-NI when the C++ library is available, hashlib sharded across the
+    process pool otherwise (hashlib releases the GIL, so the fallback
+    scales too).  Bit-identical to the device path by construction."""
+    from celestia_tpu.utils import hostpool, native
+
+    msgs = np.ascontiguousarray(msgs, dtype=np.uint8)
+    if msgs.ndim != 2:
+        raise ValueError(f"msgs must be [n, L], got {msgs.shape}")
+    if native.available():
+        return native.sha256_batch(msgs, nthreads=nthreads)
+    import hashlib
+
+    n = msgs.shape[0]
+    workers = nthreads if nthreads is not None else hostpool.cpu_threads()
+    workers = max(1, min(workers, n))
+    out = np.zeros((n, 32), dtype=np.uint8)
+
+    def shard(t: int) -> None:
+        for i in range(t, n, workers):
+            out[i] = np.frombuffer(
+                hashlib.sha256(msgs[i].tobytes()).digest(), dtype=np.uint8
+            )
+
+    hostpool.run_sharded(shard, range(workers))
+    return out

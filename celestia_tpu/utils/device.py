@@ -13,6 +13,30 @@ from __future__ import annotations
 
 import subprocess
 import sys
+from typing import Optional
+
+_host_regime: Optional[bool] = None
+
+
+def host_regime() -> bool:
+    """True when this process's default jax backend is the host CPU —
+    the regime every node lives in while the device tunnel is down.
+
+    The host-regime fast paths (da/dah.py) route the DA pipeline through
+    the pooled native C++ legs instead of compiling XLA CPU programs
+    (minutes at k=128).  Cached: the default backend cannot change within
+    a process.  Only call from code that already initializes jax — the
+    first call touches the backend."""
+    global _host_regime
+    if _host_regime is None:
+        try:
+            import jax
+
+            _host_regime = jax.default_backend() == "cpu"
+        except Exception:
+            # no usable jax backend at all: host-only by definition
+            _host_regime = True
+    return _host_regime
 
 
 def backend_available(

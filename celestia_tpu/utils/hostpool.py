@@ -1,0 +1,91 @@
+"""Process-wide host worker pool for the CPU DA pipeline.
+
+Every host-side leg of the DA path (native NMT/SHA hashing, the Leopard
+erasure decode, the pure-Python fallbacks) fans out over ONE shared pool
+so the node never oversubscribes the machine: N subsystems each spawning
+``os.cpu_count()`` threads would thrash; one pool sized once does not.
+
+Thread-count resolution order (first match wins):
+
+1. an explicit :func:`set_cpu_threads` call (the ``--cpu-threads`` CLI
+   flag routes here);
+2. the ``CELESTIA_TPU_CPU_THREADS`` environment variable;
+3. ``os.cpu_count()``.
+
+The native C++ entry points take the resolved count as an ``nthreads``
+argument (they spawn their own short-lived ``std::thread`` teams — cheap
+relative to the multi-ms work items); the :class:`ThreadPoolExecutor`
+from :func:`get_pool` serves the pure-Python legs, where hashlib/numpy
+release the GIL.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, List, Optional
+
+_lock = threading.Lock()
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_size = 0
+_override: Optional[int] = None
+
+
+def set_cpu_threads(n: Optional[int]) -> None:
+    """Pin the pool size (``--cpu-threads``); ``None`` clears the pin.
+
+    Takes effect for every subsequent :func:`cpu_threads` /
+    :func:`get_pool` call; an existing pool is rebuilt lazily."""
+    global _override
+    if n is not None and n < 1:
+        raise ValueError(f"cpu threads must be >= 1, got {n}")
+    with _lock:
+        _override = n
+
+
+def cpu_threads() -> int:
+    """The host worker count every CPU DA leg should use."""
+    with _lock:
+        if _override is not None:
+            return _override
+    env = os.environ.get("CELESTIA_TPU_CPU_THREADS", "").strip()
+    if env:
+        try:
+            n = int(env)
+            if n >= 1:
+                return n
+        except ValueError:
+            pass  # malformed env var: fall through to the default
+    return os.cpu_count() or 1
+
+
+def get_pool() -> ThreadPoolExecutor:
+    """The shared executor, (re)built to the current cpu_threads()."""
+    global _pool, _pool_size
+    n = cpu_threads()
+    with _lock:
+        if _pool is None or _pool_size != n:
+            # the replaced executor is NOT shut down: a concurrent caller
+            # may hold it between its get_pool() and .map(), and
+            # scheduling on a shut-down executor raises.  It simply
+            # drains and idles — a resize is a rare config-time event,
+            # and parked workers cost nothing.
+            _pool = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="celestia-host"
+            )
+            _pool_size = n
+        return _pool
+
+
+def run_sharded(fn: Callable, items: Iterable) -> List:
+    """Map ``fn`` over ``items`` on the shared pool, preserving order.
+
+    Runs inline for a single worker or a single item (no pool overhead,
+    and results stay deterministic either way — callers rely on the
+    threaded path being byte-identical to the serial one).  The first
+    worker exception propagates."""
+    items = list(items)
+    if cpu_threads() <= 1 or len(items) <= 1:
+        return [fn(x) for x in items]
+    return list(get_pool().map(fn, items))

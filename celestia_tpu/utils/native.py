@@ -11,6 +11,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from pathlib import Path
 from typing import Optional
 
@@ -72,6 +73,15 @@ def _load() -> Optional[ctypes.CDLL]:
     except AttributeError:
         return None  # stale .so predating this round: see codec guard below
     lib.eds_nmt_roots.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p]
+    try:
+        lib.eds_nmt_roots_mt.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, u8p, ctypes.c_int,
+        ]
+        lib.sha256_batch_mt.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, u8p, ctypes.c_int,
+        ]
+    except AttributeError:
+        return None  # stale .so predating the threaded hashing entry points
     lib.gf_matmul_axes.argtypes = [
         u8p, u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int,
@@ -126,10 +136,19 @@ def _ptr(a: np.ndarray):
 
 _loaded_codec: Optional[str] = None
 
+# Serializes gf_load_mul against every in-flight table-method call
+# (ADVICE r5): the native MUL table is process-global, so a codec switch
+# racing an rs_extend_square / extend_block_cpu / gf_matmul_axes call on
+# another thread would compute in a mixed field and return silently
+# wrong parity.  Each table-method wrapper holds this lock across BOTH
+# _ensure_field and the native call; re-entrant so nested helpers work.
+_field_lock = threading.RLock()
+
 
 def _ensure_field(lib) -> None:
     """Keep the native MUL table in the active codec's representation so
-    table-method GF legs here stay bit-identical to the device path."""
+    table-method GF legs here stay bit-identical to the device path.
+    Callers must hold ``_field_lock`` across this AND the native call."""
     global _loaded_codec
     from celestia_tpu.ops import gf256
 
@@ -141,6 +160,17 @@ def _ensure_field(lib) -> None:
     _loaded_codec = codec
 
 
+def _resolve_threads(nthreads: Optional[int]) -> int:
+    """None -> the process-wide pool size (``--cpu-threads`` /
+    CELESTIA_TPU_CPU_THREADS / os.cpu_count); ints pass through (0 keeps
+    the C side's hardware_concurrency fallback)."""
+    if nthreads is None:
+        from celestia_tpu.utils import hostpool
+
+        return hostpool.cpu_threads()
+    return nthreads
+
+
 def rs_extend_square(square: np.ndarray) -> np.ndarray:
     """uint8[k, k, B] -> uint8[2k, 2k, B] (bit-identical to the device)."""
     from celestia_tpu.ops.gf256 import encode_matrix
@@ -148,28 +178,32 @@ def rs_extend_square(square: np.ndarray) -> np.ndarray:
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
-    _ensure_field(lib)
     square = np.ascontiguousarray(square, dtype=np.uint8)
     k, B = square.shape[0], square.shape[2]
     E = np.ascontiguousarray(encode_matrix(k))
     out = np.zeros((2 * k, 2 * k, B), dtype=np.uint8)
-    lib.rs_extend_square(_ptr(square), _ptr(E), _ptr(out), k, B)
+    with _field_lock:
+        _ensure_field(lib)
+        lib.rs_extend_square(_ptr(square), _ptr(E), _ptr(out), k, B)
     return out
 
 
-def sha256_batch(msgs: np.ndarray) -> np.ndarray:
+def sha256_batch(msgs: np.ndarray, nthreads: Optional[int] = None) -> np.ndarray:
+    """SHA-256 over n equal-length rows, striped across the host pool."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
     msgs = np.ascontiguousarray(msgs, dtype=np.uint8)
     n, length = msgs.shape
     out = np.zeros((n, 32), dtype=np.uint8)
-    lib.sha256_batch(_ptr(msgs), n, length, _ptr(out))
+    lib.sha256_batch_mt(_ptr(msgs), n, length, _ptr(out),
+                        _resolve_threads(nthreads))
     return out
 
 
-def eds_nmt_roots(eds: np.ndarray) -> np.ndarray:
-    """uint8[2k, 2k, B] -> uint8[4k, 90] (rows then columns)."""
+def eds_nmt_roots(eds: np.ndarray, nthreads: Optional[int] = None) -> np.ndarray:
+    """uint8[2k, 2k, B] -> uint8[4k, 90] (rows then columns), the 4k
+    independent trees sharded across the host pool."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
@@ -177,32 +211,35 @@ def eds_nmt_roots(eds: np.ndarray) -> np.ndarray:
     n = eds.shape[0]
     k = n // 2
     out = np.zeros((2 * n, 90), dtype=np.uint8)
-    lib.eds_nmt_roots(_ptr(eds), k, eds.shape[2], _ptr(out))
+    lib.eds_nmt_roots_mt(_ptr(eds), k, eds.shape[2], _ptr(out),
+                         _resolve_threads(nthreads))
     return out
 
 
-def extend_block_cpu(square: np.ndarray, nthreads: int = 0):
+def extend_block_cpu(square: np.ndarray, nthreads: Optional[int] = None):
     """Full CPU ExtendBlock: square -> (eds, axis roots, data root).
 
-    Threaded native pipeline — the honest CPU comparison leg for bench.py
-    (role of Leopard-RS + crypto/sha256 in the reference, SURVEY.md §2.2).
+    Threaded native pipeline with the extend->roots overlap — the honest
+    CPU comparison leg for bench.py (role of Leopard-RS + crypto/sha256
+    in the reference, SURVEY.md §2.2).
     """
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
     from celestia_tpu.ops.gf256 import encode_matrix
 
-    _ensure_field(lib)
     square = np.ascontiguousarray(square, dtype=np.uint8)
     k, B = square.shape[0], square.shape[2]
     E = np.ascontiguousarray(encode_matrix(k))
     eds = np.zeros((2 * k, 2 * k, B), dtype=np.uint8)
     roots = np.zeros((4 * k, 90), dtype=np.uint8)
     data_root = np.zeros(32, dtype=np.uint8)
-    lib.extend_block_cpu(
-        _ptr(square), _ptr(E), k, B, nthreads, _ptr(eds), _ptr(roots),
-        _ptr(data_root),
-    )
+    with _field_lock:
+        _ensure_field(lib)
+        lib.extend_block_cpu(
+            _ptr(square), _ptr(E), k, B, _resolve_threads(nthreads),
+            _ptr(eds), _ptr(roots), _ptr(data_root),
+        )
     return eds, roots, data_root
 
 
@@ -220,7 +257,9 @@ def leo_encode(data: np.ndarray) -> np.ndarray:
     return parity
 
 
-def leo_extend_square(square: np.ndarray, nthreads: int = 0) -> np.ndarray:
+def leo_extend_square(
+    square: np.ndarray, nthreads: Optional[int] = None
+) -> np.ndarray:
     """Leopard-codec square extension (FFT per axis): uint8[k, k, B] ->
     uint8[2k, 2k, B], quadrant layout as rs_extend_square."""
     lib = _load()
@@ -229,12 +268,14 @@ def leo_extend_square(square: np.ndarray, nthreads: int = 0) -> np.ndarray:
     square = np.ascontiguousarray(square, dtype=np.uint8)
     k, B = square.shape[0], square.shape[2]
     eds = np.zeros((2 * k, 2 * k, B), dtype=np.uint8)
-    lib.leo_extend_square_cpu(_ptr(square), _ptr(eds), k, B, nthreads)
+    lib.leo_extend_square_cpu(
+        _ptr(square), _ptr(eds), k, B, _resolve_threads(nthreads)
+    )
     return eds
 
 
 def leo_decode_axes(
-    data: np.ndarray, present: np.ndarray, nthreads: int = 0
+    data: np.ndarray, present: np.ndarray, nthreads: Optional[int] = None
 ) -> np.ndarray:
     """Leopard O(n log n) erasure decode, IN PLACE, threaded across axes.
 
@@ -257,12 +298,15 @@ def leo_decode_axes(
         raise ValueError(f"axis length must be a power of two <= 256, got {n}")
     ok = np.zeros(n_axes, dtype=np.uint8)
     lib.leo_decode_axes(
-        _ptr(data), _ptr(present), n_axes, n, B, _ptr(ok), nthreads
+        _ptr(data), _ptr(present), n_axes, n, B, _ptr(ok),
+        _resolve_threads(nthreads),
     )
     return ok
 
 
-def extend_block_leopard_cpu(square: np.ndarray, nthreads: int = 0):
+def extend_block_leopard_cpu(
+    square: np.ndarray, nthreads: Optional[int] = None
+):
     """Full CPU ExtendBlock via the Leopard O(n log n) FFT codec:
     square -> (eds, axis roots, data root).  The honest vs_leopard_cpu
     comparison leg for bench.py (the reference's codec class at full
@@ -276,8 +320,8 @@ def extend_block_leopard_cpu(square: np.ndarray, nthreads: int = 0):
     roots = np.zeros((4 * k, 90), dtype=np.uint8)
     data_root = np.zeros(32, dtype=np.uint8)
     lib.extend_block_leopard_cpu(
-        _ptr(square), k, B, nthreads, _ptr(eds), _ptr(roots),
-        _ptr(data_root),
+        _ptr(square), k, B, _resolve_threads(nthreads), _ptr(eds),
+        _ptr(roots), _ptr(data_root),
     )
     return eds, roots, data_root
 
@@ -320,7 +364,7 @@ def create_commitment(leaves: np.ndarray, sizes) -> bytes:
 
 def create_commitments_batch(
     leaves: np.ndarray, blob_off: np.ndarray, sizes: np.ndarray,
-    size_off: np.ndarray, nthreads: int = 0,
+    size_off: np.ndarray, nthreads: Optional[int] = None,
 ) -> np.ndarray:
     """Commitments for MANY blobs in one call: leaves uint8[total, leaf_len]
     (all blobs' ns-prefixed shares concatenated), blob_off int32[n+1] row
@@ -339,18 +383,20 @@ def create_commitments_batch(
     lib.create_commitments_batch(
         _ptr(leaves), leaves.shape[1],
         blob_off.ctypes.data_as(i32), sizes.ctypes.data_as(i32),
-        size_off.ctypes.data_as(i32), n, _ptr(out), nthreads,
+        size_off.ctypes.data_as(i32), n, _ptr(out),
+        _resolve_threads(nthreads),
     )
     return out
 
 
-def gf_matmul_axes(D: np.ndarray, X: np.ndarray, nthreads: int = 0) -> np.ndarray:
+def gf_matmul_axes(
+    D: np.ndarray, X: np.ndarray, nthreads: Optional[int] = None
+) -> np.ndarray:
     """Per-axis GF(256) matmul: D uint8[n, R, k] x X uint8[n, k, B] ->
     uint8[n, R, B] (the repair decode step, threaded)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
-    _ensure_field(lib)
     D = np.ascontiguousarray(D, dtype=np.uint8)
     X = np.ascontiguousarray(X, dtype=np.uint8)
     n, R, k = D.shape
@@ -358,7 +404,12 @@ def gf_matmul_axes(D: np.ndarray, X: np.ndarray, nthreads: int = 0) -> np.ndarra
     if X.shape != (n, k, B):
         raise ValueError(f"X must be ({n}, {k}, B), got {X.shape}")
     out = np.zeros((n, R, B), dtype=np.uint8)
-    lib.gf_matmul_axes(_ptr(D), _ptr(X), _ptr(out), n, R, k, B, nthreads)
+    with _field_lock:
+        _ensure_field(lib)
+        lib.gf_matmul_axes(
+            _ptr(D), _ptr(X), _ptr(out), n, R, k, B,
+            _resolve_threads(nthreads),
+        )
     return out
 
 
@@ -386,7 +437,8 @@ def ecmul_double(u1_be: bytes, u2_be: bytes, pub33: bytes):
 
 
 def ecmul_double_batch(
-    u1s: np.ndarray, u2s: np.ndarray, pubs: np.ndarray, nthreads: int = 0
+    u1s: np.ndarray, u2s: np.ndarray, pubs: np.ndarray,
+    nthreads: Optional[int] = None,
 ):
     """Threaded batch of ecmul_double.
 
@@ -403,7 +455,8 @@ def ecmul_double_batch(
     out_x = np.zeros((n, 32), dtype=np.uint8)
     ok = np.zeros(n, dtype=np.uint8)
     lib.secp256k1_ecmul_double_batch(
-        _ptr(u1s), _ptr(u2s), _ptr(pubs), n, _ptr(out_x), _ptr(ok), nthreads
+        _ptr(u1s), _ptr(u2s), _ptr(pubs), n, _ptr(out_x), _ptr(ok),
+        _resolve_threads(nthreads),
     )
     return ok, out_x
 
@@ -413,7 +466,8 @@ def has_glv() -> bool:
 
 
 def ecmul_double_glv_batch(
-    ks: np.ndarray, signs: np.ndarray, pubs: np.ndarray, nthreads: int = 0
+    ks: np.ndarray, signs: np.ndarray, pubs: np.ndarray,
+    nthreads: Optional[int] = None,
 ):
     """Threaded batch of GLV-split double multiplications.
 
@@ -433,6 +487,7 @@ def ecmul_double_glv_batch(
     out_x = np.zeros((n, 32), dtype=np.uint8)
     ok = np.zeros(n, dtype=np.uint8)
     lib.secp256k1_ecmul_double_glv_batch(
-        _ptr(ks), _ptr(signs), _ptr(pubs), n, _ptr(out_x), _ptr(ok), nthreads
+        _ptr(ks), _ptr(signs), _ptr(pubs), n, _ptr(out_x), _ptr(ok),
+        _resolve_threads(nthreads),
     )
     return ok, out_x

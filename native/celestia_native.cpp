@@ -19,6 +19,7 @@
 #include <immintrin.h>
 #endif
 
+#include <atomic>
 #include <thread>
 #include <vector>
 
@@ -55,6 +56,16 @@ void gf_init(void) {
 // the Python side loads the active codec's field representation (the
 // leopard codec works in the Cantor-index domain, gf256.mul_table) so
 // every table-method leg here computes in the same field as the device.
+//
+// INVARIANT (ADVICE r5): MUL is process-global and this write is not
+// synchronized against readers.  The Python binding
+// (celestia_tpu/utils/native.py) therefore holds one lock across BOTH
+// the gf_load_mul call and every table-method entry point
+// (rs_extend_square / extend_block_cpu / gf_matmul_axes), so a codec
+// switch can never interleave with an in-flight table-method call and
+// compute in a mixed field.  Callers bypassing the Python binding must
+// uphold the same discipline: never call gf_load_mul while a
+// table-method function is running on another thread.
 void gf_load_mul(const uint8_t* table) {
     memcpy(MUL, table, 256 * 256);
     gf_ready = 1;  // later gf_init() calls must not clobber the load
@@ -484,106 +495,194 @@ static void run_striped(void (*fn)(void*, int, int), void* ctx, int count,
     for (auto& th : ts) th.join();
 }
 
+// Atomic work-queue scheduler: tasks are pulled one at a time from a
+// shared counter, so unevenly sized work items (an NMT axis root costs
+// ~4x a Leopard column encode) load-balance across the pool — the
+// property the overlapped extend->roots phase depends on.  Task order is
+// PRESERVED in dispatch (item i is claimed before item i+n), which lets
+// a mixed phase list its latency-critical items first.
+static void run_pool(void (*fn)(void*, int), void* ctx, int count,
+                     int nthreads) {
+    int nt = nthreads < count ? nthreads : count;
+    if (nt <= 1) {
+        for (int i = 0; i < count; i++) fn(ctx, i);
+        return;
+    }
+    std::atomic<int> next(0);
+    auto work = [&]() {
+        int i;
+        while ((i = next.fetch_add(1, std::memory_order_relaxed)) < count)
+            fn(ctx, i);
+    };
+    std::vector<std::thread> ts;
+    for (int t = 0; t < nt; t++) ts.emplace_back(work);
+    for (auto& th : ts) th.join();
+}
+
+static int resolve_threads(int nthreads) {
+    if (nthreads > 0) return nthreads;
+    int hc = (int)std::thread::hardware_concurrency();
+    return hc > 0 ? hc : 1;
+}
+
 struct RootsCtx {
     const uint8_t* eds;
     uint8_t* roots;
     int k, B, n;
+};
+
+// One NMT axis root of an EDS.  a in [0, 2n): rows first, then columns;
+// the Q0 namespace-prefix rule matches eds_nmt_roots.  The ~139 KB leaf
+// scratch (k=128) is thread_local — one allocation per worker thread,
+// not one mmap/munmap pair per axis on the hot path.
+static void eds_axis_root(const RootsCtx& c, int a) {
+    const int leaf_len = NS + c.B;
+    thread_local std::vector<uint8_t> scratch;
+    if (scratch.size() < (size_t)c.n * leaf_len)
+        scratch.resize((size_t)c.n * leaf_len);
+    uint8_t* leaves = scratch.data();
+    const int is_col = a >= c.n;
+    const int idx = is_col ? a - c.n : a;
+    for (int j = 0; j < c.n; j++) {
+        const int r = is_col ? j : idx;
+        const int col = is_col ? idx : j;
+        const uint8_t* cell = c.eds + ((size_t)r * c.n + col) * c.B;
+        uint8_t* leaf = leaves + (size_t)j * leaf_len;
+        if (r < c.k && col < c.k) memcpy(leaf, cell, NS);
+        else memset(leaf, 0xFF, NS);
+        memcpy(leaf + NS, cell, c.B);
+    }
+    nmt_root(leaves, c.n, leaf_len, c.roots + (size_t)a * DIGEST);
+}
+
+// Standalone threaded exports of the hashing stage: the Python host
+// pipeline (celestia_tpu/ops/nmt.py, da/dah.py host regime) calls these
+// directly so Python-side hashing disappears from the hot loop.
+
+// All 4k NMT axis roots of an EDS, sharded across nthreads worker
+// threads (0 = hardware concurrency).  out: 4k x 90, rows then columns.
+void eds_nmt_roots_mt(const uint8_t* eds, int k, int B, uint8_t* out,
+                      int nthreads) {
+    nthreads = resolve_threads(nthreads);
+    const int n = 2 * k;
+    RootsCtx ctx = {eds, out, k, B, n};
+    run_pool(
+        [](void* p, int i) { eds_axis_root(*(RootsCtx*)p, i); },
+        &ctx, 2 * n, nthreads);
+}
+
+// Threaded batch SHA-256 over n equal-length messages (rows), striped
+// across nthreads threads — the batched SHA-256-over-rows entry point.
+void sha256_batch_mt(const uint8_t* msgs, int n, int len, uint8_t* out,
+                     int nthreads) {
+    nthreads = resolve_threads(nthreads);
+    struct Ctx {
+        const uint8_t* msgs;
+        int n, len;
+        uint8_t* out;
+    } ctx = {msgs, n, len, out};
+    run_striped(
+        [](void* p, int t, int nt) {
+            Ctx& c = *(Ctx*)p;
+            for (int i = t; i < c.n; i += nt)
+                sha256_one(c.msgs + (size_t)i * c.len, c.len,
+                           c.out + (size_t)i * 32);
+        },
+        &ctx, n, nthreads);
+}
+
+// ---------------------------------------------------------------------------
+// Overlapped extend -> roots pipeline (shared by the table-method and
+// leopard legs).  Three phases over one worker pool:
+//
+//   1. Q0 + Q1 per original row (the top half of the EDS is complete
+//      at the barrier);
+//   2. column extension (produces Q2/Q3) INTERLEAVED with the top-half
+//      ROW roots, which depend only on phase 1 — row-root hashing
+//      starts while the extension is still producing the remaining
+//      quadrants instead of waiting for the whole square;
+//   3. the remaining axis roots (bottom rows + all columns), then the
+//      RFC-6962 data root.
+//
+// Phase 2 lists the columns first: the critical path runs through the
+// extension, and run_pool's in-order dispatch makes the roots pure
+// filler for threads that run out of column work.
+// ---------------------------------------------------------------------------
+
+void leo_encode(const uint8_t* data, int k, int B, uint8_t* parity);
+
+struct ExtendRootsCtx {
+    const uint8_t* square;
+    const uint8_t* E;  // encode matrix (table method); null for leopard
+    uint8_t* eds;
+    RootsCtx roots;
+    int k, B, n, use_leo;
     size_t row_bytes;
 };
 
-// 4k NMT axis roots (rows then cols) + RFC-6962 data root of an EDS —
-// the post-extension stage shared by the table-method and leopard legs.
-static void eds_roots_threaded(const uint8_t* eds, int k, int B,
-                               int nthreads, uint8_t* roots,
-                               uint8_t* data_root) {
+static void ext_row_task(ExtendRootsCtx& c, int r) {
+    uint8_t* row = c.eds + (size_t)r * c.row_bytes;
+    memcpy(row, c.square + (size_t)r * c.k * c.B, (size_t)c.k * c.B);
+    if (c.use_leo) leo_encode(row, c.k, c.B, row + (size_t)c.k * c.B);
+    else rs_encode_axis(c.E, row, row + (size_t)c.k * c.B, c.k, c.B);
+}
+
+static void ext_col_task(ExtendRootsCtx& c, int cc) {
+    thread_local std::vector<uint8_t> gather;
+    if (gather.size() < 2 * (size_t)c.k * c.B)
+        gather.resize(2 * (size_t)c.k * c.B);
+    uint8_t* col = gather.data();
+    uint8_t* par = col + (size_t)c.k * c.B;
+    for (int r = 0; r < c.k; r++)
+        memcpy(col + (size_t)r * c.B,
+               c.eds + (size_t)r * c.row_bytes + (size_t)cc * c.B, c.B);
+    if (c.use_leo) leo_encode(col, c.k, c.B, par);
+    else rs_encode_axis(c.E, col, par, c.k, c.B);
+    for (int r = 0; r < c.k; r++)
+        memcpy(c.eds + (size_t)(c.k + r) * c.row_bytes + (size_t)cc * c.B,
+               par + (size_t)r * c.B, c.B);
+}
+
+static void extend_block_overlapped(const uint8_t* square, const uint8_t* E,
+                                    int use_leo, int k, int B, int nthreads,
+                                    uint8_t* eds, uint8_t* roots,
+                                    uint8_t* data_root) {
+    nthreads = resolve_threads(nthreads);
     const int n = 2 * k;
-    RootsCtx ctx = {eds, roots, k, B, n, (size_t)n * B};
-    run_striped(
-        [](void* p, int t, int nt) {
-            RootsCtx& c = *(RootsCtx*)p;
-            const int leaf_len = NS + c.B;
-            uint8_t* leaves = new uint8_t[(size_t)c.n * leaf_len];
-            for (int a = t; a < 2 * c.n; a += nt) {
-                const int is_col = a >= c.n;
-                const int idx = is_col ? a - c.n : a;
-                for (int j = 0; j < c.n; j++) {
-                    const int r = is_col ? j : idx;
-                    const int col = is_col ? idx : j;
-                    const uint8_t* cell =
-                        c.eds + ((size_t)r * c.n + col) * c.B;
-                    uint8_t* leaf = leaves + (size_t)j * leaf_len;
-                    if (r < c.k && col < c.k) memcpy(leaf, cell, NS);
-                    else memset(leaf, 0xFF, NS);
-                    memcpy(leaf + NS, cell, c.B);
-                }
-                nmt_root(leaves, c.n, leaf_len,
-                         c.roots + (size_t)a * DIGEST);
-            }
-            delete[] leaves;
+    ExtendRootsCtx ctx = {square, E,     eds, {eds, roots, k, B, n},
+                          k,      B,     n,   use_leo,
+                          (size_t)n * B};
+    // phase 1: Q0 + Q1 rows
+    run_pool(
+        [](void* p, int i) { ext_row_task(*(ExtendRootsCtx*)p, i); },
+        &ctx, k, nthreads);
+    // phase 2: columns + top-half row roots, overlapped
+    run_pool(
+        [](void* p, int i) {
+            ExtendRootsCtx& c = *(ExtendRootsCtx*)p;
+            if (i < c.n) ext_col_task(c, i);
+            else eds_axis_root(c.roots, i - c.n);  // row roots [0, k)
         },
-        &ctx, 2 * n, nthreads);
+        &ctx, n + k, nthreads);
+    // phase 3: remaining axis roots (rows [k, n) + columns [n, 2n))
+    run_pool(
+        [](void* p, int i) {
+            ExtendRootsCtx& c = *(ExtendRootsCtx*)p;
+            eds_axis_root(c.roots, c.k + i);
+        },
+        &ctx, 3 * k, nthreads);
     rfc6962_root_pow2_cpu(roots, 2 * n, DIGEST, data_root);
 }
 
 // Full ExtendBlock on the CPU: square k*k*B -> EDS 2k*2k*B, 4k NMT axis
 // roots (4k x 90) and the RFC-6962 data root (32 bytes), using nthreads
-// worker threads (0 = hardware concurrency).
+// worker threads (0 = hardware concurrency), extend and roots overlapped.
 void extend_block_cpu(const uint8_t* square, const uint8_t* E, int k, int B,
                       int nthreads, uint8_t* eds, uint8_t* roots,
                       uint8_t* data_root) {
     gf_init();
-    if (nthreads <= 0) {
-        nthreads = (int)std::thread::hardware_concurrency();
-        if (nthreads <= 0) nthreads = 1;
-    }
-    const int n = 2 * k;
-    const size_t row_bytes = (size_t)n * B;
-    auto run = [&](void (*fn)(void*, int, int), void* ctx, int count) {
-        run_striped(fn, ctx, count, nthreads);
-    };
-    struct Ctx {
-        const uint8_t* square;
-        const uint8_t* E;
-        uint8_t* eds;
-        uint8_t* roots;
-        int k, B, n;
-        size_t row_bytes;
-    } ctx = {square, E, eds, roots, k, B, n, row_bytes};
-    // Q0 + Q1 per original row, rows striped across threads
-    run(
-        [](void* p, int t, int nt) {
-            Ctx& c = *(Ctx*)p;
-            for (int r = t; r < c.k; r += nt) {
-                memcpy(c.eds + r * c.row_bytes, c.square + (size_t)r * c.k * c.B,
-                       (size_t)c.k * c.B);
-                rs_encode_axis(c.E, c.eds + r * c.row_bytes,
-                               c.eds + r * c.row_bytes + (size_t)c.k * c.B, c.k,
-                               c.B);
-            }
-        },
-        &ctx, k);
-    // Q2/Q3 per column, striped
-    run(
-        [](void* p, int t, int nt) {
-            Ctx& c = *(Ctx*)p;
-            uint8_t* col = new uint8_t[(size_t)c.k * c.B];
-            uint8_t* par = new uint8_t[(size_t)c.k * c.B];
-            for (int cc = t; cc < c.n; cc += nt) {
-                for (int r = 0; r < c.k; r++)
-                    memcpy(col + (size_t)r * c.B,
-                           c.eds + r * c.row_bytes + (size_t)cc * c.B, c.B);
-                rs_encode_axis(c.E, col, par, c.k, c.B);
-                for (int r = 0; r < c.k; r++)
-                    memcpy(c.eds + (size_t)(c.k + r) * c.row_bytes +
-                               (size_t)cc * c.B,
-                           par + (size_t)r * c.B, c.B);
-            }
-            delete[] col;
-            delete[] par;
-        },
-        &ctx, n);
-    // 4k NMT axis roots + data root (shared post-extension stage)
-    eds_roots_threaded(eds, k, B, nthreads, roots, data_root);
+    extend_block_overlapped(square, E, 0, k, B, nthreads, eds, roots,
+                            data_root);
 }
 
 // ---------------------------------------------------------------------------
@@ -948,17 +1047,15 @@ void leo_decode_axes(uint8_t* data, const uint8_t* present, int n_axes,
         &ctx, n_axes, nthreads);
 }
 
-// Full leopard-codec ExtendBlock: the O(n log n) FFT extension + the same
-// threaded NMT/data-root stage — the honest vs_leopard_cpu bench leg.
+// Full leopard-codec ExtendBlock: the O(n log n) FFT extension with the
+// NMT/data-root stage overlapped into it — the honest vs_leopard_cpu
+// bench leg and the host-regime hot path.
 void extend_block_leopard_cpu(const uint8_t* square, int k, int B,
                               int nthreads, uint8_t* eds, uint8_t* roots,
                               uint8_t* data_root) {
-    if (nthreads <= 0) {
-        nthreads = (int)std::thread::hardware_concurrency();
-        if (nthreads <= 0) nthreads = 1;
-    }
-    leo_extend_square_cpu(square, eds, k, B, nthreads);
-    eds_roots_threaded(eds, k, B, nthreads, roots, data_root);
+    leo_init();
+    extend_block_overlapped(square, nullptr, 1, k, B, nthreads, eds, roots,
+                            data_root);
 }
 
 // ---------------------------------------------------------------------------

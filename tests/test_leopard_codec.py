@@ -193,6 +193,84 @@ LAG_16_DAH = bytes.fromhex(
     "a5e15795f7d53d9368ffce460432e4cca3ad5f14acf3d91b9102a6c12e12e861"
 )
 
+# Checked-in golden PARITY vectors for fixed non-constant shards (ADVICE
+# r5): raw Leopard FF8 parity bytes, pinned as hex literals so any later
+# refactor of the FFT/threading/field code diffs against frozen data
+# rather than a co-evolving in-repo oracle.  Generated once (2026-08-03)
+# from two independently derived in-tree constructions (LCH FFT ==
+# Lagrange matrix); the cross-check against klauspost/reedsolomon itself
+# still needs a Go toolchain and stays an open item (ROADMAP).
+# {k: (data_hex, parity_hex)}; data is k rows of (32 // k * 8) bytes.
+LEO_GOLDEN_PARITY = {
+    4: (
+        "af7b54cc27a09ac1ea5b1187053056687ec2410de7291b902c7c106bd4c18512",
+        "e1d36fce7b754d67850c0ab4715e00f6477601bccfbf3886343770ebd4ec273c",
+    ),
+    32: (
+        "7c08b69fb45d6b6bac0a976c9bfdfbca9fd37abdf55a31d14ee906a5e6eb1e77"
+        "eb1fa4b062ab552ca9f526ec0c4bf3397c708e4e08d5ff5eb2ce864f94f0858c"
+        "c18707d15cf9ffa5060e35c3ddde661aa000286c62b8656848cb66e566411629"
+        "0d1b66715ce987793bfbfec26a4bef9cb0621d4429a8300d1a211fb2164df72c",
+        "b09389f3f3953276be0c6aa5dc9f56423e4957104dc1d9805834c3fc525fa3ab"
+        "fbb61d0f97c9886050dea4282cecf92ef1814a716f83585da8d74b6e8c2f6d00"
+        "a2a84e912873e4b4ce749395cd13fc8416777990e62633e63a465ab7c78ebfcb"
+        "6cc53db346adcfc5608803d272fd29aaaa8fe7e8a3abe96265331f3d5e2e219b",
+    ),
+}
+
+
+def test_golden_parity_vectors_pin_leopard_bytes():
+    """The frozen hex vectors above must be reproduced by the Lagrange
+    construction — and by the native FFT when present — byte for byte."""
+    for k, (data_hex, parity_hex) in LEO_GOLDEN_PARITY.items():
+        data = np.frombuffer(bytes.fromhex(data_hex), dtype=np.uint8)
+        data = data.reshape(k, -1)
+        want = np.frombuffer(
+            bytes.fromhex(parity_hex), dtype=np.uint8
+        ).reshape(k, -1)
+        got_mat = gf256.encode_shares_ref(data, codec=gf256.CODEC_LEOPARD)
+        assert np.array_equal(got_mat, want), f"lagrange k={k}"
+        if native.available():
+            got_fft = native.leo_encode(data)
+            assert np.array_equal(got_fft, want), f"fft k={k}"
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_threaded_host_pipeline_byte_identical():
+    """The pooled host DA pipeline must be byte-identical to the
+    single-threaded one at k in {4, 16, 32}: extension, the overlapped
+    NMT axis roots, the data root, the standalone root shard, and a
+    repaired square (the consensus-determinism requirement — thread
+    count can never change bytes)."""
+    rng = np.random.default_rng(20260803)
+    for k in (4, 16, 32):
+        sq = rng.integers(0, 256, (k, k, 512), dtype=np.uint8)
+        base = native.extend_block_leopard_cpu(sq, nthreads=1)
+        for t in (2, 4):
+            eds, roots, droot = native.extend_block_leopard_cpu(
+                sq, nthreads=t
+            )
+            assert np.array_equal(eds, base[0]), (k, t)
+            assert np.array_equal(roots, base[1]), (k, t)
+            assert np.array_equal(droot, base[2]), (k, t)
+        # standalone pooled NMT root shard == single-threaded
+        assert np.array_equal(
+            native.eds_nmt_roots(base[0], nthreads=4),
+            native.eds_nmt_roots(base[0], nthreads=1),
+        ), k
+        # pooled repair == single-threaded repair == the original square
+        avail = rng.random((2 * k, 2 * k)) >= 0.25
+        damaged = base[0].copy()
+        damaged[~avail] = 0
+        rr, cc = base[1][: 2 * k], base[1][2 * k :]
+        one = rs.repair_square(
+            damaged, avail, row_roots=rr, col_roots=cc, nthreads=1
+        )
+        many = rs.repair_square(
+            damaged, avail, row_roots=rr, col_roots=cc, nthreads=4
+        )
+        assert np.array_equal(one, base[0]) and np.array_equal(many, one), k
+
 
 @pytest.mark.skipif(not native.available(), reason="native lib unavailable")
 def test_non_constant_square_vectors_pin_parity():

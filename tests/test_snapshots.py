@@ -123,3 +123,91 @@ def test_restored_node_keeps_snapshotting(tmp_path):
     _post_blob(restored, s2, 3)
     _post_blob(restored, s2, 4)  # heights 3,4 -> snapshot at 4
     assert [s.height for s in store.list()] == [2, 4]
+
+
+# -- state-sync DoS bounds (ADVICE r5) ---------------------------------
+
+
+def test_assemble_rejects_oversized_chunk():
+    """A chunk above the writer's size cap is hostile by definition and
+    must be rejected BEFORE decompression, regardless of its hash."""
+    import hashlib
+
+    from celestia_tpu.node import snapshots as snap_mod
+
+    chunk = b"\x00" * (snap_mod.MAX_WIRE_CHUNK_BYTES + 1)
+    meta = {
+        "chunks": 1,
+        "chunk_hashes": [hashlib.sha256(chunk).hexdigest()],
+    }
+    with pytest.raises(snap_mod.SnapshotLimitError, match="cap"):
+        SnapshotStore.assemble(meta, [chunk])
+
+
+def test_assemble_caps_decompression(monkeypatch):
+    """A zlib bomb must abort at the output cap, not after materializing
+    the full decompressed payload."""
+    import hashlib
+    import zlib
+
+    from celestia_tpu.node import snapshots as snap_mod
+
+    monkeypatch.setattr(snap_mod, "MAX_STATE_BYTES", 1024)
+    payload = zlib.compress(b'"' + b"a" * 100_000 + b'"', level=9)
+    meta = {
+        "chunks": 1,
+        "chunk_hashes": [hashlib.sha256(payload).hexdigest()],
+    }
+    with pytest.raises(snap_mod.SnapshotLimitError, match="decompression"):
+        SnapshotStore.assemble(meta, [payload])
+
+
+def test_assemble_rejects_trailing_garbage():
+    import hashlib
+    import zlib
+
+    payload = zlib.compress(b"{}") + b"junk"
+    meta = {
+        "chunks": 1,
+        "chunk_hashes": [hashlib.sha256(payload).hexdigest()],
+    }
+    with pytest.raises(ValueError, match="zlib stream"):
+        SnapshotStore.assemble(meta, [payload])
+
+
+def test_state_sync_aborts_and_backs_off_on_oversized_chunk(monkeypatch):
+    """A peer serving an oversized snapshot chunk gets the whole sync
+    attempt aborted and a long pull cooldown — the syncing node never
+    buffers past the per-chunk bound (gossip._fetch_snapshot_chunks)."""
+    import time
+
+    from celestia_tpu.node import snapshots as snap_mod
+    from celestia_tpu.node.gossip import GossipEngine
+
+    node = TestNode(auto_produce=False)
+    eng = GossipEngine(node, [])
+    meta = {
+        "height": node.height + 5,
+        "format": 1,
+        "chunks": 1,
+        "chunk_hashes": ["00" * 32],
+    }
+    # the anchor certificate is out of scope here: pretend it verified so
+    # the fetch path (the code under test) actually runs
+    monkeypatch.setattr(
+        node, "verify_state_sync_anchor", lambda m, a: (True, ""),
+        raising=False,
+    )
+
+    class _EvilCli:
+        def snapshot_list(self):
+            return [dict(meta)]
+
+        def bft_decided(self, h):
+            return {"anchor": True}
+
+        def snapshot_chunk(self, height, fmt, idx):
+            return b"\x00" * (snap_mod.MAX_WIRE_CHUNK_BYTES + 1)
+
+    assert eng._try_state_sync(_EvilCli(), "evil:1") is False
+    assert eng._pull_backoff.get("evil:1", 0.0) > time.time() + 30

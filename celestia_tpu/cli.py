@@ -904,7 +904,9 @@ def _genesis_errors(genesis: dict) -> list:
         except Exception as e:
             errors.append(f"InitChain rejected the genesis: {e}")
         finally:
-            gf256.set_active_codec(prev_codec)
+            # deliberate restore of a temporary switch — exempt from the
+            # pin-once-at-genesis guard
+            gf256.set_active_codec(prev_codec, force=True)
     return errors
 
 

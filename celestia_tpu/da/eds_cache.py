@@ -1,0 +1,168 @@
+"""Content-addressed EDS/DAH cache for the proposal lifecycle.
+
+The north-star workload runs ExtendBlock TWICE per block per validator:
+the proposer extends its own square in PrepareProposal and then
+re-extends the identical square when it ProcessProposal-validates its
+own block; every other validator re-extends the same square once per
+gossip validation, and round restarts re-extend it again.  The square —
+and therefore the EDS and DAH — is a pure function of
+
+    (block txs, square size, app version, active share codec)
+
+so those repeats are content-addressed lookups, not recomputes ("On the
+Encoding Process in Decentralized Systems", arxiv 2408.15203: redundant
+re-encoding of unchanged data dominates decentralized encoding cost).
+
+Safety invariants (enforced here and pinned by tests/test_eds_cache.py):
+
+* The key is a sha256 over the FULL length-prefixed tx bytes plus the
+  layout/version/codec parameters — NEVER the claimed data_root.  A
+  byzantine proposer that advertises the data_root of a cached honest
+  block but ships different txs hashes to a different key, recomputes,
+  and is rejected on the root mismatch like before.
+* Only the extend is ever skipped.  ProcessProposal's ante checks,
+  signature verification and strict square reconstruction still run on
+  every proposal; the cache replaces only `extend_block(square)`, whose
+  input the caller has already re-derived from the tx bytes.
+* Entries are immutable pairs (ExtendedDataSquare, DataAvailabilityHeader)
+  inserted only after an honest local computation.  A hit returns the
+  exact object a cold run would have produced byte-for-byte (asserted
+  for both codecs by the tests).
+
+The cache is process-global (one chain per process — the same pin-once
+invariant the codec selection documents in ops/gf256.py) and bounded:
+a 128x128 EDS is ~32 MiB of shares, so the LRU holds a handful of
+recent proposals, which covers the prepare->process->commit lifecycle
+of the current height plus round-restart re-proposals.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+_KEY_DOMAIN = b"celestia-tpu/eds-cache/v1|"
+
+# ~8 entries x ~32 MiB (k=128 host EDS) keeps the worst case around a
+# quarter GiB; smaller squares are proportionally cheaper.  Overridable
+# for memory-constrained deployments.
+DEFAULT_MAX_ENTRIES = int(os.environ.get("CELESTIA_TPU_EDS_CACHE", "8"))
+
+
+def make_key(
+    block_txs: List[bytes], square_size: int, app_version: int, codec: str
+) -> bytes:
+    """sha256(canonical block_txs || square_size || app_version || codec).
+
+    Txs are length-prefixed so shifting bytes across tx boundaries can
+    never alias two different proposals to one key; the claimed
+    data_root is deliberately NOT part of the key (see module docs).
+    """
+    h = hashlib.sha256()
+    h.update(_KEY_DOMAIN)
+    h.update(len(block_txs).to_bytes(4, "big"))
+    for raw in block_txs:
+        h.update(len(raw).to_bytes(4, "big"))
+        h.update(raw)
+    h.update(int(square_size).to_bytes(4, "big"))
+    h.update(int(app_version).to_bytes(8, "big"))
+    h.update(codec.encode())
+    return h.digest()
+
+
+def min_dah_key(codec: str) -> bytes:
+    """Key of the minimal (empty) square's entry — the first resident of
+    the cache (da/dah.py min_data_availability_header).  Identical to a
+    genuine empty proposal's key modulo the app_version sentinel: the
+    value is the same either way (build([]) IS the empty block's square),
+    but the min-DAH is version-independent so it pins version 0."""
+    return make_key([], 1, 0, codec)
+
+
+class EdsCache:
+    """Bounded, thread-safe LRU of content-key -> (eds, dah)."""
+
+    def __init__(self, max_entries: int = DEFAULT_MAX_ENTRIES):
+        self.max_entries = max(1, int(max_entries))
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[bytes, Tuple[object, object]]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.puts = 0
+        self.evictions = 0
+
+    def get(self, key: bytes) -> Optional[Tuple[object, object]]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry
+
+    def peek(self, key: bytes) -> Optional[Tuple[object, object]]:
+        """get() without touching the hit/miss counters (the min-DAH
+        lookups would drown the block-level hit rate).  LRU recency IS
+        refreshed: the min-DAH entry must not sit perpetually first in
+        the eviction line just because its reads never count."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+            return entry
+
+    def put(self, key: bytes, eds, dah) -> None:
+        with self._lock:
+            self._entries[key] = (eds, dah)
+            self._entries.move_to_end(key)
+            self.puts += 1
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.hits = self.misses = self.puts = self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "puts": self.puts,
+                "evictions": self.evictions,
+                "hit_rate": (self.hits / total) if total else 0.0,
+            }
+
+
+# The process-global instance every App / dah helper shares (content-
+# addressed keys make sharing across App instances in one process safe:
+# two apps that hash to the same key would compute the same bytes).
+CACHE = EdsCache()
+
+
+def get(key: bytes):
+    return CACHE.get(key)
+
+
+def put(key: bytes, eds, dah) -> None:
+    CACHE.put(key, eds, dah)
+
+
+def clear() -> None:
+    CACHE.clear()
+
+
+def stats() -> dict:
+    return CACHE.stats()

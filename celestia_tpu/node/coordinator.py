@@ -17,6 +17,16 @@ log — all chain state lives in the validator processes, which is what makes
 this a real replication test: the processes share nothing but their
 genesis file and these RPCs.
 
+Proposal-lifecycle caching (PR 5): the coordinator deliberately stays
+dumb — the redundant-work elimination lives in the validator processes.
+A proposer's ``cons_prepare`` populates its content-addressed EDS cache
+(da/eds_cache.py) and pins the PreparedProposal for its own
+``cons_commit`` (testnode._pending_proposal); a round restart where the
+SAME proposer re-prepares an unchanged mempool is an EDS-cache hit, and
+every validator's ``cons_process`` of a re-gossiped block it has already
+validated skips the re-extend the same way.  The coordinator never
+carries EDS bytes over the wire — only (txs, square_size, data_root).
+
 Reference analogue: celestia-core's consensus driving N nodes over p2p
 (test/e2e/testnet.go:62-96 shape); SURVEY §2.3 state-machine replication.
 """

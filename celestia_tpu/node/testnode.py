@@ -308,6 +308,11 @@ class TestNode:
             proposal = self.app.prepare_proposal([t.raw for t in mem_txs])
         except Exception:
             return None
+        # keep the proposer's own (EDS, DAH, layout): its validate leg
+        # hits the content-addressed EDS cache instead of re-extending,
+        # and _bft_decide reuses the artifacts for proof serving (the
+        # same wiring the coordinator path has via cons_prepare)
+        self._pending_proposal = proposal
         last_commit = ()
         prev = self._bft.decided.get(height - 1)
         if prev is not None:
@@ -351,10 +356,12 @@ class TestNode:
         # certificate over the SORTED valset, never from local votes
         vote_pairs = last_commit_vote_pairs(self._bft.validators, payload)
         self._now_ns = payload.time_ns
+        artifacts = self._take_pending_artifacts(payload.data_root)
         self._apply_block(
             payload.height, payload.time_ns, list(payload.txs),
             payload.data_root, payload.square_size,
             proposer=payload.proposer, votes=vote_pairs,
+            artifacts=artifacts,
         )
 
     def bft_start(self, height: int) -> None:
@@ -655,6 +662,19 @@ class TestNode:
             proposer=val_addr, votes=[(val_addr, True)],
         )
 
+    def _take_pending_artifacts(self, data_root: bytes):
+        """Consume the proposer's own PreparedProposal if it matches the
+        block being committed: when WE proposed this block, commit with
+        the prepared (EDS/DAH/layout) so proof queries serve from the
+        cache without a reconstruct+re-extend.  The data-root match
+        guards staleness (a restarted round that re-prepared different
+        txs); the pending slot is cleared either way."""
+        pending = getattr(self, "_pending_proposal", None)
+        self._pending_proposal = None
+        if pending is not None and pending.data_root == data_root:
+            return pending
+        return None
+
     def _apply_block(
         self,
         height: int,
@@ -775,13 +795,7 @@ class TestNode:
                 raise ValueError(
                     f"commit height {height} != expected {self.height + 1}"
                 )
-            pending = getattr(self, "_pending_proposal", None)
-            artifacts = (
-                pending
-                if pending is not None and pending.data_root == data_root
-                else None
-            )
-            self._pending_proposal = None
+            artifacts = self._take_pending_artifacts(data_root)
             block = self._apply_block(
                 height, time_ns, block_txs, data_root, square_size,
                 artifacts=artifacts, proposer=proposer, votes=votes,

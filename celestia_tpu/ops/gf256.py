@@ -111,17 +111,63 @@ _FIELD_TABLES = {
 
 _ACTIVE_CODEC = CODEC_LEOPARD
 
+# Pin-once-at-genesis enforcement (ROADMAP r5 follow-up): once the native
+# library has loaded the active codec's MUL table (utils/native.py
+# _ensure_field — the first "native use"), a codec SWITCH outside tests
+# hard-fails instead of silently re-keying every downstream artifact.
+# The utils/native.py field lock already closes the data race; this guard
+# documents and enforces the INVARIANT: the codec is a consensus constant
+# pinned at genesis (ADR-012), one chain per process, and everything keyed
+# by it after first use — native field tables, jit caches, the EDS cache
+# and row memo in da/ — assumes it never changes underneath them.
+_codec_used = False
+
 
 def active_codec() -> str:
     return _ACTIVE_CODEC
 
 
-def set_active_codec(codec: str) -> None:
+def mark_codec_used() -> None:
+    """Called by utils/native.py when the process-global field tables are
+    first loaded; from then on the active codec is frozen (see below)."""
+    global _codec_used
+    _codec_used = True
+
+
+def codec_used() -> bool:
+    return _codec_used
+
+
+def _in_tests() -> bool:
+    import os
+
+    return "PYTEST_CURRENT_TEST" in os.environ
+
+
+def set_active_codec(codec: str, force: bool = False) -> None:
     """Select the share codec process-wide (one chain per process; the
-    app pins this from genesis at init — ADR-012)."""
+    app pins this from genesis at init — ADR-012).
+
+    Re-pinning the SAME codec is always a no-op.  Switching codecs after
+    the first native use refuses outside tests (``force=True`` or a
+    running pytest session overrides — tests exercise both codecs in one
+    process and re-derive every cached artifact per codec key)."""
     global _ACTIVE_CODEC
     if codec not in CODECS:
         raise ValueError(f"unknown codec {codec!r}; expected one of {CODECS}")
+    if (
+        codec != _ACTIVE_CODEC
+        and _codec_used
+        and not force
+        and not _in_tests()
+    ):
+        raise RuntimeError(
+            f"cannot switch the share codec from {_ACTIVE_CODEC!r} to "
+            f"{codec!r}: the codec is a consensus constant pinned at genesis "
+            "(ADR-012) and this process already computed with the active "
+            "codec's field tables.  Start a fresh process for a chain with "
+            "a different codec."
+        )
     _ACTIVE_CODEC = codec
 
 

@@ -226,6 +226,12 @@ class App:
 
     def _set_app_version(self, v: int) -> None:
         self.store.store("meta").set(_APP_VERSION_KEY, v.to_bytes(8, "big"))
+        # decoded-tx verdicts can be version-dependent (ante/blob rules
+        # change across app versions): a version change invalidates them.
+        # The signature cache survives — a signature over exact raw bytes
+        # is version-independent — and the EDS cache keys on app_version,
+        # so its stale entries simply stop matching.
+        self._decoded_cache.clear()
 
     def next_height(self) -> int:
         """Height the next tx would execute at: the in-flight block during
@@ -515,6 +521,34 @@ class App:
                 continue
         return kept
 
+    def _extend_block_cached(
+        self, block_txs: List[bytes], square, leg: str
+    ) -> Tuple["dah_mod.ExtendedDataSquare", "dah_mod.DataAvailabilityHeader"]:
+        """ExtendBlock through the content-addressed EDS cache.
+
+        The key commits to the FULL tx bytes + square size + app version +
+        active codec — never to a claimed data root — so only a proposal
+        whose square this node already extended honestly can hit.  The
+        proposer's own ProcessProposal re-extend, round-restart
+        re-proposals and repeated gossip validations of one block all
+        collapse to a lookup; everything else (ante, signatures, square
+        reconstruction, the root comparison) still runs in the caller.
+        """
+        from celestia_tpu.da import eds_cache
+        from celestia_tpu.ops import gf256 as _gf256
+
+        key = eds_cache.make_key(
+            block_txs, square.size, self.app_version, _gf256.active_codec()
+        )
+        cached = eds_cache.get(key)
+        if cached is not None:
+            self.telemetry.incr(f"eds_cache_hit_{leg}")
+            return cached
+        self.telemetry.incr(f"eds_cache_miss_{leg}")
+        eds, dah = dah_mod.extend_block(square)
+        eds_cache.put(key, eds, dah)
+        return eds, dah
+
     def prepare_proposal(self, txs: List[bytes]) -> PreparedProposal:
         t0 = _time.time()
         try:
@@ -524,7 +558,7 @@ class App:
                 kept, self.max_effective_square_size()
             )
             t2 = _time.time()
-            eds, dah = dah_mod.extend_block(square)
+            eds, dah = self._extend_block_cached(block_txs, square, "prepare")
             t3 = _time.time()
             # per-phase budget (SURVEY §7 hard part c): host tx filtering,
             # host square assembly, device extension incl. transfer —
@@ -583,7 +617,10 @@ class App:
                     time_ns=self.block_time_ns,
                 )
                 run_ante(ctx)
-            # strict reconstruction
+            # strict reconstruction — NOT skippable on a cache hit: the
+            # square must be re-derivable from the tx bytes under the
+            # CURRENT size bound, and only that reconstruction makes the
+            # cached (txs -> EDS/DAH) mapping apply to this proposal
             square, re_txs, _ = construct_square(
                 block_txs, self.max_effective_square_size()
             )
@@ -592,7 +629,7 @@ class App:
                     f"square size mismatch: computed {square.size}, "
                     f"header says {square_size}"
                 )
-            _, dah = dah_mod.extend_block(square)
+            _, dah = self._extend_block_cached(block_txs, square, "process")
             if dah.hash != data_root:
                 self.telemetry.incr("process_proposal_rejected_data_root")
                 return False, (
@@ -634,14 +671,27 @@ class App:
 
     def deliver_tx(self, raw: bytes) -> TxResult:
         """Execute one block tx (blob txs execute their inner PFB only —
-        blobs never touch state; keeper.go:42-57)."""
-        btx = unmarshal_blob_tx(raw)
-        if btx is not None:
-            tx = unmarshal_tx(btx.tx)
-            raw_inner = btx.tx
+        blobs never touch state; keeper.go:42-57).
+
+        Decode-once: the protobuf decode done by CheckTx / the proposal
+        legs is reused by raw-bytes hash.  READ-ONLY consult — delivery
+        skips blob validation by design (committed blobs never touch
+        state), so it must never seed the cache the proposal legs treat
+        as proof of full BlobTx validation."""
+        key = _hashlib.sha256(raw).digest()
+        hit = self._decoded_cache.get(key)
+        if hit is not None:
+            self._decoded_cache.move_to_end(key)
+            self.telemetry.incr("decoded_cache_hit_deliver")
+            tx, raw_inner = hit
         else:
-            tx = unmarshal_tx(raw)
-            raw_inner = raw
+            btx = unmarshal_blob_tx(raw)
+            if btx is not None:
+                tx = unmarshal_tx(btx.tx)
+                raw_inner = btx.tx
+            else:
+                tx = unmarshal_tx(raw)
+                raw_inner = raw
         # Phase 1 (SDK runTx parity): the ante chain runs on its own branch;
         # on success its writes (fee deduction, sequence bump) persist even
         # if message execution later fails.

@@ -158,6 +158,9 @@ def _ensure_field(lib) -> None:
     table = np.ascontiguousarray(gf256.mul_table(codec))
     lib.gf_load_mul(_ptr(table))
     _loaded_codec = codec
+    # first native use of the codec's field: from here on set_active_codec
+    # refuses to SWITCH codecs outside tests (pin-once-at-genesis)
+    gf256.mark_codec_used()
 
 
 def _resolve_threads(nthreads: Optional[int]) -> int:

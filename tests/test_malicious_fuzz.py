@@ -126,6 +126,79 @@ def test_prepare_process_consistency_fuzz():
         assert ok, f"round {round_i}: honest proposal rejected: {reason}"
 
 
+def test_malicious_square_cannot_launder_through_warm_eds_cache():
+    """PR 5 adversarial gate: a byzantine proposer whose claimed data_root
+    matches an entry this validator ALREADY cached (it validated the
+    honest block for the same txs) must still be rejected when the square
+    is wrong — the cache key is the tx bytes, never the claimed root, so
+    the out-of-order square can only reach the recompute + mismatch."""
+    from celestia_tpu.da import eds_cache
+
+    genesis, key, _ = _funded_app_and_key(b"launder-fuzz")
+    byzantine = MaliciousApp(handler="out_of_order")
+    byzantine.init_chain(genesis)
+    honest = App()
+    honest.init_chain(genesis)
+    txs = _pfb_raw(key, byzantine, n=2, seed=3)
+
+    # warm the honest validator's cache with the HONEST block for these txs
+    honest_proposal = App.prepare_proposal(honest, txs)
+    ok, _ = honest.process_proposal(
+        honest_proposal.block_txs,
+        honest_proposal.square_size,
+        honest_proposal.data_root,
+    )
+    assert ok
+    assert honest.telemetry.counters.get("eds_cache_hit_process") == 1
+
+    # byzantine proposal: same txs, shuffled square, HONESTLY computed
+    # root of the malicious square (not equal to the honest root)
+    proposal = byzantine.prepare_proposal(txs)
+    assert proposal.data_root != honest_proposal.data_root
+    ok, reason = honest.process_proposal(
+        proposal.block_txs, proposal.square_size, proposal.data_root
+    )
+    assert not ok and "data root mismatch" in reason
+
+    # byzantine proposal variant: same txs, CLAIMING the honest cached
+    # root for a reordered square — the hit returns the honest DAH, whose
+    # root equals the claim, and that is CORRECT: the tx bytes determine
+    # the canonical square, and the canonical square's root IS the claim.
+    # The malicious ordering itself is unrepresentable in (txs, root)
+    # form — which is exactly why caching on tx bytes is sound.
+    ok, _ = honest.process_proposal(
+        proposal.block_txs, proposal.square_size, honest_proposal.data_root
+    )
+    assert ok
+
+    # mutated tx bytes alias nothing: cache miss + rejection
+    hits_before = eds_cache.stats()["hits"]
+    mutated = list(honest_proposal.block_txs)
+    mutated[0] = mutated[0][:-1] + bytes([mutated[0][-1] ^ 0x01])
+    ok, _ = honest.process_proposal(
+        mutated, honest_proposal.square_size, honest_proposal.data_root
+    )
+    assert not ok
+    assert eds_cache.stats()["hits"] == hits_before
+
+
+def test_lying_data_root_rejected_with_warm_cache():
+    """The liar's own prepare populates the process-global cache with the
+    honest (txs -> DAH) mapping; the honest validator's hit exposes the
+    lie instead of masking it."""
+    genesis, key, _ = _funded_app_and_key(b"liar-warm")
+    byzantine = MaliciousApp(handler="lying_data_root")
+    byzantine.init_chain(genesis)
+    honest = App()
+    honest.init_chain(genesis)
+    txs = _pfb_raw(key, byzantine, n=1, seed=5)
+    proposal = byzantine.prepare_proposal(txs)
+    ok, reason = honest.process_proposal(
+        proposal.block_txs, proposal.square_size, proposal.data_root
+    )
+    assert not ok and "data root mismatch" in reason
+
+
 def test_txsim_sequences():
     node = TestNode()
     sequences = (

@@ -1,0 +1,101 @@
+// gen_leopard_vectors: reference Leopard FF8 parity for the golden pins.
+//
+// The in-tree LEO_GOLDEN_PARITY vectors (tests/test_leopard_codec.py) were
+// generated from two independently derived in-tree constructions (LCH FFT
+// == Lagrange matrix), but both share this repo's Cantor-basis assumptions.
+// This program computes the same parity through klauspost/reedsolomon's
+// Leopard GF(2^8) codec — the exact library the reference chain uses via
+// rsmt2d.NewLeoRSCodec (pkg/appconsts/global_consts.go:91-92) — so the pin
+// stops being self-referential wherever a Go toolchain (and module
+// network access on first run) is available.  tests/test_leopard_vectors_go.py
+// runs it when `go` is on PATH and skips otherwise.
+//
+// Protocol (stdin -> stdout, one vector per line):
+//
+//	input:  "<k>:<data_hex>"   data_hex = k equal-length data shards, concatenated
+//	output: "<parity_hex>"     k parity shards, concatenated, same shard length
+//
+// Leopard requires shard sizes that are a multiple of 64 bytes; RS over
+// GF(2^8) encodes every byte offset independently, so short shards are
+// zero-padded to 64 and the parity truncated back — exact, not an
+// approximation.
+package main
+
+import (
+	"bufio"
+	"encoding/hex"
+	"fmt"
+	"os"
+	"strconv"
+	"strings"
+
+	"github.com/klauspost/reedsolomon"
+)
+
+const leopardShardAlign = 64
+
+func encodeOne(k int, data []byte) (string, error) {
+	if k <= 0 || len(data)%k != 0 {
+		return "", fmt.Errorf("data length %d not divisible by k=%d", len(data), k)
+	}
+	shardLen := len(data) / k
+	padded := ((shardLen + leopardShardAlign - 1) / leopardShardAlign) * leopardShardAlign
+	shards := make([][]byte, 2*k)
+	for i := 0; i < k; i++ {
+		shards[i] = make([]byte, padded)
+		copy(shards[i], data[i*shardLen:(i+1)*shardLen])
+	}
+	for i := k; i < 2*k; i++ {
+		shards[i] = make([]byte, padded)
+	}
+	// WithLeopardGF(true) forces the Leopard FF8 code regardless of shard
+	// count — the construction rsmt2d.NewLeoRSCodec selects.
+	enc, err := reedsolomon.New(k, k, reedsolomon.WithLeopardGF(true))
+	if err != nil {
+		return "", err
+	}
+	if err := enc.Encode(shards); err != nil {
+		return "", err
+	}
+	out := make([]byte, 0, k*shardLen)
+	for i := k; i < 2*k; i++ {
+		out = append(out, shards[i][:shardLen]...)
+	}
+	return hex.EncodeToString(out), nil
+}
+
+func main() {
+	sc := bufio.NewScanner(os.Stdin)
+	sc.Buffer(make([]byte, 1<<20), 1<<20)
+	for sc.Scan() {
+		line := strings.TrimSpace(sc.Text())
+		if line == "" || strings.HasPrefix(line, "#") {
+			continue
+		}
+		parts := strings.SplitN(line, ":", 2)
+		if len(parts) != 2 {
+			fmt.Fprintf(os.Stderr, "bad input line: %q\n", line)
+			os.Exit(2)
+		}
+		k, err := strconv.Atoi(parts[0])
+		if err != nil {
+			fmt.Fprintf(os.Stderr, "bad k: %v\n", err)
+			os.Exit(2)
+		}
+		data, err := hex.DecodeString(parts[1])
+		if err != nil {
+			fmt.Fprintf(os.Stderr, "bad hex: %v\n", err)
+			os.Exit(2)
+		}
+		parity, err := encodeOne(k, data)
+		if err != nil {
+			fmt.Fprintf(os.Stderr, "encode failed: %v\n", err)
+			os.Exit(1)
+		}
+		fmt.Println(parity)
+	}
+	if err := sc.Err(); err != nil {
+		fmt.Fprintf(os.Stderr, "read failed: %v\n", err)
+		os.Exit(2)
+	}
+}

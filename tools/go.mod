@@ -1,0 +1,5 @@
+module celestia-tpu/tools
+
+go 1.21
+
+require github.com/klauspost/reedsolomon v1.12.1

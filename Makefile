@@ -1,0 +1,34 @@
+# celestia-tpu developer targets.  `make lint` and the tier-1 pytest run
+# (which includes tests/test_lint.py) are the review gates; the sanitizer
+# target hardens the native pipeline whenever the toolchain allows.
+
+PY ?= python
+
+.PHONY: lint test native native-sanitize native-sanitize-tsan native-sanitize-asan bench
+
+## celint: concurrency & determinism static analysis (exit 1 on findings)
+lint:
+	$(PY) -m celestia_tpu.lint
+
+## tier-1 test suite (same selection the CI driver runs)
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
+
+## (re)build the production native library
+native:
+	$(PY) -c "from celestia_tpu.utils import native; assert native.available(), 'native build failed'"
+
+## rebuild native/celestia_native.cpp under TSan and ASan+UBSan and re-run
+## the thread-scaling byte-identity tests under each (loud SKIP when the
+## toolchain lacks the sanitizer; hard failure otherwise)
+native-sanitize:
+	bash tools/native_sanitize.sh all
+
+native-sanitize-tsan:
+	bash tools/native_sanitize.sh tsan
+
+native-sanitize-asan:
+	bash tools/native_sanitize.sh asan
+
+bench:
+	$(PY) bench.py

@@ -599,6 +599,33 @@ def _row_memo_reuse(k: int):
     }
 
 
+def _unified_cache_stats() -> dict:
+    """Process-wide view of every bounded cache (utils/lru.py registry):
+    per-cache hit rate / evictions / approximate resident bytes plus the
+    summed footprint against the CELESTIA_TPU_CACHE_BUDGET_MB advisory
+    budget — the LRU-consolidation telemetry BENCH_r06 captures.  The
+    legacy eds_cache_* keys above are produced by the domain wrapper and
+    stay byte-for-byte compatible; this section is additive."""
+    from celestia_tpu.utils import lru
+
+    stats = lru.registry_stats()
+    caches = {}
+    for name, agg in sorted(stats["caches"].items()):
+        caches[name] = {
+            "instances": agg["instances"],
+            "entries": agg["entries"],
+            "hit_rate": round(agg["hit_rate"], 3),
+            "evictions": agg["evictions"],
+            "approx_bytes": agg["approx_bytes"],
+        }
+    return {
+        "caches": caches,
+        "total_approx_bytes": stats["total_approx_bytes"],
+        "budget_bytes": stats["budget_bytes"],
+        "over_budget": stats["over_budget"],
+    }
+
+
 def _host_repair_ms(k: int):
     """Host-only repair (the light-client/DAS path — no accelerator):
     25% withheld, root-verified.  Under the leopard codec this runs the
@@ -734,6 +761,11 @@ def _host_only_main():
         extras["row_memo"] = _row_memo_reuse(K)
     except Exception as e:
         extras["row_memo_error"] = repr(e)[:200]
+    try:
+        # LAST: snapshot after every leg has exercised its caches
+        extras["unified_caches"] = _unified_cache_stats()
+    except Exception as e:
+        extras["unified_caches_error"] = repr(e)[:200]
     leg = extras.get("cpu_leg", "table_gf_cpu")
     print(
         json.dumps(
@@ -875,6 +907,11 @@ def main():
             extras["dah_128_fixture_match"] = bool(_dah_128_fixture_match())
     except Exception as e:
         extras["dah_128_fixture_error"] = repr(e)[:200]
+    try:
+        # LAST: snapshot after every leg has exercised its caches
+        extras["unified_caches"] = _unified_cache_stats()
+    except Exception as e:
+        extras["unified_caches_error"] = repr(e)[:200]
 
     vs = round(cpu_ms / device_ms, 1) if cpu_ms else 0.0
     print(

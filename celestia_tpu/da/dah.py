@@ -13,7 +13,6 @@ XLA executable per square size.  This runs twice per block per validator
 from __future__ import annotations
 
 import threading
-from collections import OrderedDict
 from dataclasses import dataclass
 from functools import lru_cache
 from typing import Dict, List, Optional, Tuple
@@ -32,6 +31,7 @@ from celestia_tpu.ops import nmt as nmt_ops
 from celestia_tpu.ops import rs
 from celestia_tpu.ops.gf256 import active_codec as _active_codec
 from celestia_tpu.ops.gf256 import encode_matrix_bits
+from celestia_tpu.utils.lru import LruCache
 
 NMT_ROOT_SIZE = nmt_ops.NMT_DIGEST_SIZE  # 90
 DATA_ROOT_SIZE = 32
@@ -258,62 +258,57 @@ def _extend_and_header_host(
 
 
 class _RowMemo:
-    """(k, codec, sha256(row bytes)) -> (parity row bytes, row root bytes)."""
+    """(k, codec, sha256(row bytes)) -> (parity row bytes, row root bytes).
+
+    Domain wrapper over the unified utils/lru.py cache; the batch API and
+    the legacy stats keys (lookups/inserted/reuse_pct) are preserved for
+    bench.py's BENCH_r0x series."""
 
     def __init__(self, max_entries: int):
-        self.max_entries = max(1, int(max_entries))
-        self._lock = threading.Lock()
-        self._entries: "OrderedDict[tuple, Tuple[bytes, bytes]]" = OrderedDict()
-        self.lookups = 0
-        self.hits = 0
-        self.inserted = 0
+        self._lru = LruCache(
+            "row_memo", max_entries, weigher=_row_memo_weigher
+        )
+        # assembled is memo-path bookkeeping, not a cache counter; int
+        # += is atomic enough for a stats field under CPython
         self.assembled = 0  # squares served by the memoized assembly path
 
+    @property
+    def max_entries(self) -> int:
+        return self._lru.max_entries
+
     def lookup_many(self, k: int, codec: str, digests: List[bytes]):
-        with self._lock:
-            out = []
-            for d in digests:
-                entry = self._entries.get((k, codec, d))
-                if entry is not None:
-                    self._entries.move_to_end((k, codec, d))
-                out.append(entry)
-            self.lookups += len(digests)
-            self.hits += sum(e is not None for e in out)
-            return out
+        return self._lru.get_many((k, codec, d) for d in digests)
 
     def insert_many(self, k: int, codec: str, items) -> None:
         """items: iterable of (digest, parity_bytes, root_bytes)."""
-        with self._lock:
-            for d, parity, root in items:
-                key = (k, codec, d)
-                if key not in self._entries:
-                    self.inserted += 1
-                self._entries[key] = (parity, root)
-                self._entries.move_to_end(key)
-            while len(self._entries) > self.max_entries:
-                self._entries.popitem(last=False)
+        self._lru.put_many(
+            ((k, codec, d), (parity, root)) for d, parity, root in items
+        )
 
     def mark_assembled(self) -> None:
-        with self._lock:
-            self.assembled += 1
+        self.assembled += 1
 
     def clear(self) -> None:
-        with self._lock:
-            self._entries.clear()
-            self.lookups = self.hits = self.inserted = self.assembled = 0
+        self._lru.clear()
+        self.assembled = 0
 
     def stats(self) -> dict:
-        with self._lock:
-            return {
-                "entries": len(self._entries),
-                "lookups": self.lookups,
-                "hits": self.hits,
-                "inserted": self.inserted,
-                "assembled": self.assembled,
-                "reuse_pct": (
-                    100.0 * self.hits / self.lookups if self.lookups else 0.0
-                ),
-            }
+        s = self._lru.stats()
+        lookups = s["hits"] + s["misses"]
+        return {
+            "entries": s["entries"],
+            "lookups": lookups,
+            "hits": s["hits"],
+            "inserted": s["puts"],
+            "assembled": self.assembled,
+            "reuse_pct": (100.0 * s["hits"] / lookups) if lookups else 0.0,
+            "approx_bytes": s["approx_bytes"],
+        }
+
+
+def _row_memo_weigher(key, value) -> int:
+    parity, root = value
+    return len(parity) + len(root) + 64
 
 
 def _row_memo_max_entries() -> int:
@@ -549,22 +544,22 @@ def extend_and_header_breakdown(square: np.ndarray):
     Three device syncs instead of one fused call, so the total is a few
     RTTs WORSE than extend_and_header — use it to attribute time (bench
     breakdown, SURVEY §7 hard part c), never on the hot path."""
-    import time as _t
+    from celestia_tpu.utils.telemetry import clock as _clock
 
     square = np.asarray(square, dtype=np.uint8)
     k = square.shape[0]
-    t0 = _t.time()
+    t0 = _clock()
     dev = jax.device_put(jnp.asarray(square))
     dev.block_until_ready()
-    t1 = _t.time()
+    t1 = _clock()
     out = _extend_and_roots_fn(k, _active_codec())(dev)
     jax.block_until_ready(out)
-    t2 = _t.time()
+    t2 = _clock()
     eds_d, row_roots, col_roots, data_root = out
     rr = np.asarray(row_roots)
     cc = np.asarray(col_roots)
     droot = np.asarray(data_root).tobytes()
-    t3 = _t.time()
+    t3 = _clock()
     dah = DataAvailabilityHeader(
         tuple(rr[i].tobytes() for i in range(rr.shape[0])),
         tuple(cc[i].tobytes() for i in range(cc.shape[0])),

@@ -203,6 +203,10 @@ class LightClient:
     def __init__(self, data_root: bytes, square_size: int, seed: int = 0):
         self.data_root = data_root
         self.k = square_size
+        # celint: allow(consensus-determinism) — explicitly seeded sampling
+        # RNG: cell choice is a client-local probabilistic check whose
+        # draws never reach consensus bytes, and the seed keeps it
+        # reproducible in tests
         self._rng = np.random.default_rng(seed)
 
     def pick_coordinates(self, n: int) -> List[Tuple[int, int]]:

@@ -40,9 +40,9 @@ from __future__ import annotations
 
 import hashlib
 import os
-import threading
-from collections import OrderedDict
 from typing import List, Optional, Tuple
+
+from celestia_tpu.utils.lru import LruCache, nbytes_weigher
 
 _KEY_DOMAIN = b"celestia-tpu/eds-cache/v1|"
 
@@ -83,67 +83,54 @@ def min_dah_key(codec: str) -> bytes:
 
 
 class EdsCache:
-    """Bounded, thread-safe LRU of content-key -> (eds, dah)."""
+    """Bounded, thread-safe LRU of content-key -> (eds, dah).
+
+    Thin domain wrapper over the unified :class:`LruCache` — the pair
+    API (``put(key, eds, dah)``), the legacy stats keys and the min-DAH
+    ``peek`` semantics are preserved byte-for-byte for existing callers
+    (bench.py, tests/test_eds_cache.py)."""
 
     def __init__(self, max_entries: int = DEFAULT_MAX_ENTRIES):
-        self.max_entries = max(1, int(max_entries))
-        self._lock = threading.Lock()
-        self._entries: "OrderedDict[bytes, Tuple[object, object]]" = OrderedDict()
-        self.hits = 0
-        self.misses = 0
-        self.puts = 0
-        self.evictions = 0
+        self._lru = LruCache(
+            "eds", max_entries, weigher=nbytes_weigher
+        )
+
+    @property
+    def max_entries(self) -> int:
+        return self._lru.max_entries
 
     def get(self, key: bytes) -> Optional[Tuple[object, object]]:
-        with self._lock:
-            entry = self._entries.get(key)
-            if entry is None:
-                self.misses += 1
-                return None
-            self._entries.move_to_end(key)
-            self.hits += 1
-            return entry
+        return self._lru.get(key)
 
     def peek(self, key: bytes) -> Optional[Tuple[object, object]]:
         """get() without touching the hit/miss counters (the min-DAH
         lookups would drown the block-level hit rate).  LRU recency IS
         refreshed: the min-DAH entry must not sit perpetually first in
         the eviction line just because its reads never count."""
-        with self._lock:
-            entry = self._entries.get(key)
-            if entry is not None:
-                self._entries.move_to_end(key)
-            return entry
+        return self._lru.peek(key)
 
     def put(self, key: bytes, eds, dah) -> None:
-        with self._lock:
-            self._entries[key] = (eds, dah)
-            self._entries.move_to_end(key)
-            self.puts += 1
-            while len(self._entries) > self.max_entries:
-                self._entries.popitem(last=False)
-                self.evictions += 1
+        self._lru.put(key, (eds, dah))
 
     def clear(self) -> None:
-        with self._lock:
-            self._entries.clear()
-            self.hits = self.misses = self.puts = self.evictions = 0
+        self._lru.clear()
 
     def __len__(self) -> int:
-        with self._lock:
-            return len(self._entries)
+        return len(self._lru)
 
     def stats(self) -> dict:
-        with self._lock:
-            total = self.hits + self.misses
-            return {
-                "entries": len(self._entries),
-                "hits": self.hits,
-                "misses": self.misses,
-                "puts": self.puts,
-                "evictions": self.evictions,
-                "hit_rate": (self.hits / total) if total else 0.0,
-            }
+        s = self._lru.stats()
+        # legacy stat surface (pinned by tests + BENCH history): puts
+        # counts every insert, including replacements
+        return {
+            "entries": s["entries"],
+            "hits": s["hits"],
+            "misses": s["misses"],
+            "puts": s["puts"] + s["replacements"],
+            "evictions": s["evictions"],
+            "hit_rate": s["hit_rate"],
+            "approx_bytes": s["approx_bytes"],
+        }
 
 
 # The process-global instance every App / dah helper shares (content-

@@ -32,6 +32,12 @@ from celestia_tpu.da.blob import Blob
 from celestia_tpu.da.square import subtree_width
 from celestia_tpu.ops import nmt as nmt_ops
 from celestia_tpu.utils import native
+from celestia_tpu.utils.lru import LruCache
+
+
+def _commitment_weigher(key, value) -> int:
+    """(sha256 digest, threshold) -> 32-byte commitment entries."""
+    return len(key[0]) + len(value) + 64
 
 
 def merkle_mountain_range_sizes(total: int, max_tree_size: int) -> List[int]:
@@ -65,19 +71,19 @@ def _nmt_root_host(leaves: np.ndarray) -> bytes:
 # content-addressed commitment cache: the same blob's commitment is
 # recomputed in CheckTx, FilterTxs AND ProcessProposal (the reference
 # recomputes it at each of those validation points too); the digest key
-# makes a hit deterministic and consensus-safe.  FIFO eviction (dicts are
-# insertion-ordered) so crossing the cap never drops the whole cache
-# mid-proposal.
-_COMMITMENT_CACHE: dict = {}
-_COMMITMENT_CACHE_MAX = 8192
+# makes a hit deterministic and consensus-safe.  Shipped for two PRs as
+# an UNLOCKED plain dict mutated from pooled threads (celint rule R1's
+# founding true positive); now the unified thread-safe bounded LRU —
+# every read/insert is atomic and the eviction loop is gone.
+_COMMITMENT_CACHE = LruCache(
+    "commitment", 8192, weigher=_commitment_weigher
+)
 
 
 def create_commitment(
     blob: Blob, subtree_root_threshold: int = DEFAULT_SUBTREE_ROOT_THRESHOLD
 ) -> bytes:
     """32-byte share commitment of a blob."""
-    from celestia_tpu.da.shares import blob_shares_array
-
     key = _commitment_key(blob, subtree_root_threshold)
     cached = _COMMITMENT_CACHE.get(key)
     if cached is not None:
@@ -94,9 +100,9 @@ def create_commitment(
             roots.append(_nmt_root_host(leaves[offset : offset + s]))
             offset += s
         out = nmt_ops.rfc6962_root_np(roots).tobytes()
-    while len(_COMMITMENT_CACHE) >= _COMMITMENT_CACHE_MAX:
-        _COMMITMENT_CACHE.pop(next(iter(_COMMITMENT_CACHE)))
-    _COMMITMENT_CACHE[key] = out
+    # concurrent misses on one key race benignly: both compute the SAME
+    # bytes (the commitment is a pure function of the key), last put wins
+    _COMMITMENT_CACHE.put(key, out)
     return out
 
 
@@ -177,6 +183,4 @@ def warm_commitments(
         leaves_all, blob_off, sizes_all, size_off
     )
     for i, (key, _, _) in enumerate(pending):
-        while len(_COMMITMENT_CACHE) >= _COMMITMENT_CACHE_MAX:
-            _COMMITMENT_CACHE.pop(next(iter(_COMMITMENT_CACHE)))
-        _COMMITMENT_CACHE[key] = out[i].tobytes()
+        _COMMITMENT_CACHE.put(key, out[i].tobytes())

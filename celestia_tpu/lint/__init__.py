@@ -1,0 +1,21 @@
+"""celint: repo-specific concurrency & determinism static analysis.
+
+``python -m celestia_tpu.lint`` runs the rule catalog over the package;
+tests/test_lint.py runs it as a tier-1 gate.  See engine.py for the
+machinery, rules.py for R1-R4, specs/static_analysis.md for the docs.
+"""
+
+from celestia_tpu.lint.engine import (  # noqa: F401
+    ALIASES,
+    Finding,
+    ModuleContext,
+    REGISTRY,
+    Rule,
+    failing,
+    lint_source,
+    register,
+    render_human,
+    render_json,
+    resolve_rules,
+    run_lint,
+)

@@ -1,0 +1,71 @@
+"""CLI: ``python -m celestia_tpu.lint [paths...] [options]``.
+
+Exit status 0 when the tree is clean (every finding suppressed with a
+reason), 1 when any finding fails, 2 on usage errors — so `make lint`
+and CI can gate on it directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from celestia_tpu.lint.engine import (
+    failing,
+    render_human,
+    render_json,
+    resolve_rules,
+    run_lint,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m celestia_tpu.lint",
+        description="celint: concurrency & determinism static analysis",
+    )
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help="files/directories to lint (default: the celestia_tpu package)",
+    )
+    parser.add_argument(
+        "--rules", help="comma-separated rule ids or r1..r4 aliases "
+        "(default: all)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print suppressed findings with their reasons",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in resolve_rules(None):
+            print(f"{rule.id}: {rule.summary}")
+            if rule.doc:
+                print(f"    {rule.doc}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        findings = run_lint(args.paths or None, rule_ids)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+    if args.json:
+        print(render_json(findings))
+    else:
+        print(render_human(findings, show_suppressed=args.show_suppressed))
+    return 1 if failing(findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

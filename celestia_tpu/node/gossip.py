@@ -53,10 +53,11 @@ import json
 import random as _random
 import threading
 import time as _time
-from collections import OrderedDict, deque
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from celestia_tpu.utils.logging import Logger
+from celestia_tpu.utils.lru import LruCache, bytes_len_weigher
 
 _log = Logger(level="warn")
 
@@ -69,26 +70,21 @@ def wire_id(wire: dict) -> bytes:
 
 
 class _SeenSet:
-    """Bounded insertion-ordered membership set (flood dedup)."""
+    """Bounded membership set (flood dedup) on the unified LRU.
 
-    def __init__(self, maxlen: int = 65536):
-        self._d: "OrderedDict[bytes, bool]" = OrderedDict()
-        self._maxlen = maxlen
-        self._lock = threading.Lock()
+    ``add`` is the atomic check-then-insert the flood path needs
+    (LruCache.add_if_absent); a re-announce of a seen id refreshes its
+    recency, so actively flooded messages outlive one-shot noise."""
+
+    def __init__(self, maxlen: int = 65536, name: str = "gossip_seen"):
+        self._lru = LruCache(name, maxlen, weigher=bytes_len_weigher)
 
     def add(self, key: bytes) -> bool:
         """True if newly added, False if already present."""
-        with self._lock:
-            if key in self._d:
-                return False
-            self._d[key] = True
-            while len(self._d) > self._maxlen:
-                self._d.popitem(last=False)
-            return True
+        return self._lru.add_if_absent(key)
 
     def __contains__(self, key: bytes) -> bool:
-        with self._lock:
-            return key in self._d
+        return key in self._lru
 
 
 class _PeerLink:
@@ -241,9 +237,9 @@ class GossipEngine:
         self.reannounce_s = reannounce_s
         self._links: Dict[str, _PeerLink] = {}
         self._pull_clients: Dict[str, object] = {}
-        self._seen = _SeenSet()
-        self._seen_tx = _SeenSet()
-        self._announced = _SeenSet()
+        self._seen = _SeenSet(name="gossip_seen")
+        self._seen_tx = _SeenSet(name="gossip_seen_tx")
+        self._announced = _SeenSet(name="gossip_announced")
         # timers: (due, step, height, round); key-dedup in _timer_keys
         self._timers: List[Tuple[float, str, int, int]] = []
         self._timer_keys: set = set()

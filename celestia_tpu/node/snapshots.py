@@ -116,13 +116,23 @@ class SnapshotStore:
 
     def _iter_metas(self) -> List[dict]:
         """All snapshot metadata dicts on disk, sorted by height — the
-        single directory walk behind list() and list_wire()."""
+        single directory walk behind list() and list_wire().
+
+        Only COMMITTED snapshots are listed: a ``*.tmp`` directory is a
+        write in progress (save() publishes it atomically via rename) and
+        must never surface as restorable — and racing its rename here is
+        what made list() throw FileNotFoundError mid-state-sync.  A
+        committed dir can still vanish between iterdir() and the read
+        (concurrent prune()), so missing files are skipped, not fatal.
+        """
         out = []
         for d in sorted(self.root.iterdir()):
-            meta = d / "metadata.json"
-            if not d.is_dir() or not meta.exists():
+            if d.suffix == ".tmp" or not d.is_dir():
                 continue
-            out.append(json.loads(meta.read_text()))
+            try:
+                out.append(json.loads((d / "metadata.json").read_text()))
+            except (FileNotFoundError, NotADirectoryError):
+                continue  # pruned or re-staged between listing and read
         return sorted(out, key=lambda m: m["height"])
 
     def list(self) -> List[SnapshotInfo]:

@@ -25,6 +25,7 @@ from celestia_tpu.appconsts import (
 from celestia_tpu.client.signer import SubmitResult
 from celestia_tpu.da.blob import unmarshal_blob_tx
 from celestia_tpu.node.mempool import Mempool
+from celestia_tpu.utils.lru import LruCache
 from celestia_tpu.state.ante import AnteContext, AnteError, run_ante
 from celestia_tpu.state.app import App, TxResult
 from celestia_tpu.state.auth import AccountKeeper
@@ -178,7 +179,7 @@ class TestNode:
             b"testnode-validator"
         )
         self._bft = None  # armed by enable_bft()
-        self._bft_decided_log: Dict[int, dict] = {}
+        self._bft_decided_log = LruCache("bft_decided_log", 512)
         if recovered_blocks:
             # disk recovery: resume the chain where the logs end
             self.blocks = recovered_blocks
@@ -255,7 +256,7 @@ class TestNode:
             )
         self._bft_valset = [dict(v) for v in valset]  # for state-sync re-arm
         self._bft_block_ids: Dict[int, bytes] = {}
-        self._bft_decided_log: Dict[int, dict] = {}
+        self._bft_decided_log = LruCache("bft_decided_log", 512)
         self._bft = BFTNode(
             chain_id=self.chain_id,
             key=self._validator_key,
@@ -345,13 +346,13 @@ class TestNode:
         # prune window (the payload wire carries the full tx list, so
         # the window trades memory for how far behind a peer may fall
         # before needing a snapshot)
-        self._bft_decided_log[payload.height] = {
+        log_max = getattr(self, "bft_decided_log_max", 512)
+        if log_max != self._bft_decided_log.max_entries:
+            self._bft_decided_log.set_max_entries(log_max)
+        self._bft_decided_log.put(payload.height, {
             "payload": payload.to_wire(),
             "precommits": [v.to_wire() for v in decided.precommits],
-        }
-        log_max = getattr(self, "bft_decided_log_max", 512)
-        while len(self._bft_decided_log) > log_max:
-            self._bft_decided_log.pop(next(iter(self._bft_decided_log)))
+        })
         # identical LastCommitInfo everywhere: derived from the payload's
         # certificate over the SORTED valset, never from local votes
         vote_pairs = last_commit_vote_pairs(self._bft.validators, payload)
@@ -405,7 +406,12 @@ class TestNode:
                     "payload": d.payload.to_wire(),
                     "precommits": [v.to_wire() for v in d.precommits],
                 }
-            return self._bft_decided_log.get(height)
+            # touch=False: puts arrive in height order, so an untouched
+            # LRU evicts lowest-height first — a contiguous sliding
+            # window.  A laggard (or monitor) re-reading ancient heights
+            # must not refresh them into the retained set and fragment
+            # the "how far behind may a peer fall" window.
+            return self._bft_decided_log.get(height, touch=False)
 
     def bft_catchup(self, decided_wire: dict) -> Tuple[bool, str]:
         """Adopt an externally-replayed decided block after verifying

@@ -118,6 +118,10 @@ def _nmt_roots_np_batch(leaves: np.ndarray) -> np.ndarray:
     prefix = np.zeros((T, n, 1), dtype=np.uint8)
     h = sha256_batch_host(
         np.concatenate([prefix, leaves], axis=-1).reshape(T * n, L + 1),
+        # celint: allow(hostpool-discipline) — deliberate serial: this
+        # runs INSIDE a pool worker; fanning out onto the same executor
+        # would deadlock it (all workers blocked on futures only they
+        # could run)
         nthreads=1,
     ).reshape(T, n, 32)
     nodes = np.concatenate([ns, ns, h], axis=-1)
@@ -133,6 +137,8 @@ def _nmt_roots_np_batch(leaves: np.ndarray) -> np.ndarray:
             np.concatenate([one, left, right], axis=-1).reshape(
                 -1, 1 + 2 * NMT_DIGEST_SIZE
             ),
+            # celint: allow(hostpool-discipline) — same nested-pool
+            # deadlock avoidance as the leaf pass above
             nthreads=1,
         ).reshape(left.shape[:-1] + (32,))
         nodes = np.concatenate(

@@ -70,7 +70,16 @@ from celestia_tpu.state.tx import (
     Tx,
     unmarshal_tx,
 )
+from celestia_tpu.utils.lru import LruCache, bytes_len_weigher
 from celestia_tpu.utils.telemetry import Telemetry
+
+
+def _decoded_weigher(key, value) -> int:
+    """(tx, raw_inner) entries: the raw inner bytes dominate; the parsed
+    Tx holds commitments/signatures, approximated by a flat overhead."""
+    _, raw_inner = value
+    return len(key) + len(raw_inner) + 512
+
 
 STORE_NAMES = [
     "auth", "bank", "staking", "params", "blob", "upgrade", "blobstream",
@@ -147,10 +156,7 @@ class App:
         # verified-signature cache (tx-bytes hash -> True), bounded LRU:
         # Prepare->Process on one node and repeat validations of pooled
         # txs skip redundant EC multiplications (comet's tx cache role)
-        from collections import OrderedDict
-
-        self._sig_cache: "OrderedDict[bytes, bool]" = OrderedDict()
-        self._sig_cache_max = 8192
+        self._sig_cache = LruCache("sig", 8192, weigher=bytes_len_weigher)
         # validated-tx cache (tx-bytes hash -> (tx, raw_inner)), bounded
         # LRU: BlobTx validation recomputes every blob's share commitment
         # — deterministic in the raw bytes, so CheckTx's verdict is
@@ -158,8 +164,9 @@ class App:
         # reference revalidates at each point; caching by exact bytes is
         # the consensus-safe shortcut).  Values hold only the parsed
         # inner tx (commitments, no blob payloads), so entries are small.
-        self._decoded_cache: "OrderedDict[bytes, tuple]" = OrderedDict()
-        self._decoded_cache_max = 8192
+        self._decoded_cache = LruCache(
+            "decoded", 8192, weigher=_decoded_weigher
+        )
         # post-handler chain (posthandler.go:1-12 parity: empty default)
         self.post_handler = new_post_handler()
 
@@ -275,6 +282,10 @@ class App:
                 self.params.set(subspace, k, v)
         self._set_app_version(genesis.get("app_version", LATEST_VERSION))
         self.genesis_time_ns = genesis.get(
+            # celint: allow(consensus-determinism) — operator-side default
+            # for a genesis file that omits the timestamp; the chosen value
+            # is persisted in-store below and shipped in the genesis dump,
+            # so every validator runs from the same recorded instant
             "genesis_time_ns", _time.time_ns()
         )
         # persisted in-store so a disk-recovered node needs no side channel
@@ -400,7 +411,6 @@ class App:
             tx_keys.append(key)
             hit = self._decoded_cache.get(key)
             if hit is not None:
-                self._decoded_cache.move_to_end(key)
                 parsed.append((raw, key, None, hit))
                 continue
             btx = unmarshal_blob_tx(raw)
@@ -448,8 +458,7 @@ class App:
             keys.append(key)
             if key in batch_ok:
                 continue
-            if key in self._sig_cache:
-                self._sig_cache.move_to_end(key)
+            if self._sig_cache.get(key) is not None:
                 batch_ok[key] = True
             else:
                 batch_ok[key] = None  # to be verified below
@@ -477,16 +486,29 @@ class App:
         return out
 
     def _remember_sig(self, key: bytes) -> None:
-        self._sig_cache[key] = True
-        self._sig_cache.move_to_end(key)
-        while len(self._sig_cache) > self._sig_cache_max:
-            self._sig_cache.popitem(last=False)
+        self._sig_cache.put(key, True)
 
     def _remember_decoded(self, key: bytes, tx, raw_inner: bytes) -> None:
-        self._decoded_cache[key] = (tx, raw_inner)
-        self._decoded_cache.move_to_end(key)
-        while len(self._decoded_cache) > self._decoded_cache_max:
-            self._decoded_cache.popitem(last=False)
+        self._decoded_cache.put(key, (tx, raw_inner))
+
+    # legacy re-cap surface (tests/test_sig_cache.py assigns these): the
+    # unified LruCache trims immediately on re-cap, which subsumes the
+    # old lazy next-insert eviction
+    @property
+    def _sig_cache_max(self) -> int:
+        return self._sig_cache.max_entries
+
+    @_sig_cache_max.setter
+    def _sig_cache_max(self, n: int) -> None:
+        self._sig_cache.set_max_entries(n)
+
+    @property
+    def _decoded_cache_max(self) -> int:
+        return self._decoded_cache.max_entries
+
+    @_decoded_cache_max.setter
+    def _decoded_cache_max(self, n: int) -> None:
+        self._decoded_cache.set_max_entries(n)
 
     def _filter_txs(self, txs: List[bytes]) -> List[bytes]:
         """FilterTxs parity (validate_txs.go:29-97): run the ante chain over
@@ -550,16 +572,16 @@ class App:
         return eds, dah
 
     def prepare_proposal(self, txs: List[bytes]) -> PreparedProposal:
-        t0 = _time.time()
+        t0 = self.telemetry.clock()
         try:
             kept = self._filter_txs(txs)
-            t1 = _time.time()
+            t1 = self.telemetry.clock()
             square, block_txs, wrappers = build_square(
                 kept, self.max_effective_square_size()
             )
-            t2 = _time.time()
+            t2 = self.telemetry.clock()
             eds, dah = self._extend_block_cached(block_txs, square, "prepare")
-            t3 = _time.time()
+            t3 = self.telemetry.clock()
             # per-phase budget (SURVEY §7 hard part c): host tx filtering,
             # host square assembly, device extension incl. transfer —
             # telemetry + last_prepare_breakdown let the bench isolate
@@ -592,7 +614,7 @@ class App:
     ) -> Tuple[bool, str]:
         """Returns (accept, reason).  Panics are caught -> REJECT
         (process_proposal.go:26-34)."""
-        t0 = _time.time()
+        t0 = self.telemetry.clock()
         try:
             branch = self.store.branch()
             accounts = AccountKeeper(branch.store("auth"))
@@ -681,7 +703,6 @@ class App:
         key = _hashlib.sha256(raw).digest()
         hit = self._decoded_cache.get(key)
         if hit is not None:
-            self._decoded_cache.move_to_end(key)
             self.telemetry.incr("decoded_cache_hit_deliver")
             tx, raw_inner = hit
         else:

@@ -19,7 +19,17 @@ import numpy as np
 
 _REPO_ROOT = Path(__file__).resolve().parents[2]
 _SRC = _REPO_ROOT / "native" / "celestia_native.cpp"
-_SO = _REPO_ROOT / "native" / "celestia_native.so"
+# CELESTIA_TPU_NATIVE_SO points the loader at an alternative build of the
+# same source — the sanitizer harness (make native-sanitize) rebuilds the
+# library under TSan/ASan at a side path and re-runs the thread-scaling
+# byte-identity tests against it without disturbing the pristine .so.
+# An overridden .so is never rebuilt here: the override owns its build.
+_SO_OVERRIDE = os.environ.get("CELESTIA_TPU_NATIVE_SO", "")
+_SO = (
+    Path(_SO_OVERRIDE)
+    if _SO_OVERRIDE
+    else _REPO_ROOT / "native" / "celestia_native.so"
+)
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -49,7 +59,10 @@ def _load() -> Optional[ctypes.CDLL]:
     _tried = True
     if not _SRC.exists():
         return None
-    if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+    if _SO_OVERRIDE:
+        if not _SO.exists():
+            return None  # sanitizer harness must have built it already
+    elif not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
         if not _build():
             return None
     try:

@@ -349,6 +349,9 @@ def _ecmul_double_x(u1: int, u2: int, pub: "PublicKey"):
             np.frombuffer(ks, dtype=np.uint8).reshape(1, 128),
             np.frombuffer(signs, dtype=np.uint8).reshape(1, 4),
             pubs,
+            # celint: allow(hostpool-discipline) — single-signature path:
+            # a batch of one has nothing to fan out, and this runs inside
+            # ante handlers that may already sit on pool workers
             nthreads=1,
         )
         if not ok[0]:

@@ -13,6 +13,14 @@ from collections import defaultdict
 from typing import Dict, List
 
 
+def clock() -> float:
+    """Wall-clock read for DURATION measurement only.  celint rule R3
+    (consensus-determinism) bans direct time.* reads in state/ and da/;
+    this function (and Telemetry.clock) is the sanctioned channel — a
+    value obtained here feeds telemetry/bench, never consensus bytes."""
+    return time.time()
+
+
 class Telemetry:
     def __init__(self):
         self.counters: Dict[str, int] = defaultdict(int)
@@ -32,8 +40,19 @@ class Telemetry:
         """Record an externally-measured duration (milliseconds)."""
         self.timings[name].append(value_ms / 1000.0)
 
-    def summary(self) -> dict:
+    def clock(self) -> float:
+        """Wall-clock read for DURATION measurement only.  state/ and da/
+        code must take timestamps through here (or carry a celint allow):
+        celint rule R3 (consensus-determinism) bans direct time.* reads
+        there, and this indirection is the auditable sanctioned channel —
+        a value obtained from clock() feeds telemetry, never consensus
+        bytes."""
+        return clock()
+
+    def summary(self, include_caches: bool = False) -> dict:
         out: dict = {"counters": dict(self.counters), "gauges": dict(self.gauges)}
+        if include_caches:
+            out["caches"] = cache_stats()
         for name, vals in self.timings.items():
             s = sorted(vals)
             out[name] = {
@@ -67,4 +86,27 @@ class Telemetry:
                 )
             lines.append(f"{metric}_count {len(s)}")
             lines.append(f"{metric}_sum {sum(s):.6f}")
+        # process-wide unified cache stats (utils/lru.py registry) — the
+        # one-dashboard view of every bounded cache in the node
+        cs = cache_stats()
+        for name, agg in sorted(cs.get("caches", {}).items()):
+            for field in ("hits", "misses", "puts", "evictions"):
+                metric = f"celestia_tpu_cache_{field}_total"
+                lines.append(f'{metric}{{cache="{name}"}} {agg[field]}')
+            for field in ("entries", "approx_bytes"):
+                metric = f"celestia_tpu_cache_{field}"
+                lines.append(f'{metric}{{cache="{name}"}} {agg[field]}')
+        lines.append(
+            f"celestia_tpu_cache_total_approx_bytes {cs['total_approx_bytes']}"
+        )
         return "\n".join(lines) + "\n"
+
+
+def cache_stats() -> dict:
+    """Aggregated stats of every live bounded cache (utils/lru.py
+    registry): per-cache hits/misses/evictions/entries/approx bytes plus
+    the process-wide total against the CELESTIA_TPU_CACHE_BUDGET_MB
+    advisory budget."""
+    from celestia_tpu.utils import lru
+
+    return lru.registry_stats()

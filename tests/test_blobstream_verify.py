@@ -46,13 +46,13 @@ def net():
     remote.wait_for_height(
         (res.height // WINDOW + 1) * WINDOW, timeout_s=120.0
     )
-    yield node, remote, res.height
+    yield node, remote, res.height, server
     server.stop()
     remote.close()
 
 
 def test_attestation_queries(net):
-    node, remote, blob_height = net
+    node, remote, blob_height, _server = net
     nonce = remote.abci_query("custom/blobstream/latest_nonce", {})["nonce"]
     assert nonce >= 1
     att = remote.abci_query(
@@ -71,7 +71,7 @@ def test_attestation_queries(net):
 def test_verify_shares_end_to_end(net):
     """The full client walk over gRPC: share proof -> data root ->
     DataCommitment tuple root, every link checked locally."""
-    node, remote, blob_height = net
+    node, remote, blob_height, _server = net
     v = verify_shares(remote, blob_height, 1, 2)
     assert v.height == blob_height
     assert v.begin_block <= blob_height < v.end_block
@@ -87,22 +87,37 @@ def test_verify_shares_end_to_end(net):
 def test_verify_shares_against_in_process_node(net):
     """Same walk against the in-process node object (abci_query duck
     typing): the client verifier is transport-agnostic."""
-    node, _, blob_height = net
+    node, _, blob_height, _server = net
     v = verify_shares(node, blob_height, 1, 2)
     assert v.nonce >= 1
 
 
 def test_uncovered_height_fails(net):
-    node, remote, _ = net
-    # the current height's window has not closed yet
-    open_height = (node.height // WINDOW) * WINDOW + 1
-    if open_height <= node.height:
+    node, remote, _, server = net
+    # Heights in the STILL-OPEN window must fail verification.  The
+    # producer closes a 4-block window faster than a gRPC round-trip on
+    # a loaded host, so pause it (the loop re-reads block_interval_s
+    # each tick) instead of racing it, and drive the chain into an open
+    # window by hand.
+    import time as _t
+
+    saved = server.block_interval_s
+    server.block_interval_s = 3600.0
+    try:
+        _t.sleep(3 * saved)  # let any in-flight producer tick land
+        if (node.height // WINDOW) * WINDOW + 1 > node.height:
+            # parked exactly on a window boundary: open the next window
+            node.produce_block()
+        h = node.height
+        assert (h // WINDOW) * WINDOW + 1 <= h  # window genuinely open
         with pytest.raises(BlobstreamVerifyError, match="no DataCommitment"):
-            verify_shares(remote, node.height, 0, 1)
+            verify_shares(remote, h, 0, 1)
+    finally:
+        server.block_interval_s = saved
 
 
 def test_tampered_tuple_proof_fails(net):
-    node, remote, blob_height = net
+    node, remote, blob_height, _server = net
     att = remote.abci_query(
         "custom/blobstream/data_commitment_range", {"height": blob_height}
     )["data_commitment"]
@@ -144,7 +159,7 @@ def test_tampered_tuple_proof_fails(net):
 def test_tampering_node_response_is_caught(net):
     """A lying node that serves a consistent-looking but different data
     root for the tuple proof must fail the cross-check."""
-    node, remote, blob_height = net
+    node, remote, blob_height, _server = net
 
     class LyingNode:
         def abci_query(self, path, data):
@@ -160,7 +175,7 @@ def test_tampering_node_response_is_caught(net):
 
 def test_window_boundaries_cover_every_height(net):
     """Every height in a closed window resolves to exactly that window."""
-    node, remote, _ = net
+    node, remote, _, _server = net
     closed_end = (node.height // WINDOW) * WINDOW
     for h in range(1, closed_end + 1):
         rng = node.abci_query(

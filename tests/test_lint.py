@@ -1,0 +1,379 @@
+"""celint self-test: every rule fires on its bad fixture, stays quiet on
+its good fixture, directive hygiene is enforced, and — the actual gate —
+the real tree lints clean.  This file is what wires `make lint` into
+tier-1: a new hand-rolled cache, an unguarded mutation of annotated
+state, a wall-clock read in state/ or da/, or a literal thread count
+fails the SUITE, not review.
+"""
+
+import textwrap
+
+from celestia_tpu.lint import (
+    ALIASES,
+    REGISTRY,
+    failing,
+    lint_source,
+    resolve_rules,
+    run_lint,
+)
+
+# resolve_rules(None) imports the rule module and populates REGISTRY
+resolve_rules(None)
+
+
+def _lint(src: str, relpath: str = "celestia_tpu/node/fixture.py", rules=None):
+    return lint_source(textwrap.dedent(src), relpath, rules)
+
+
+def _ids(findings, *, include_suppressed=False):
+    return [
+        f.rule
+        for f in findings
+        if include_suppressed or not f.suppressed
+    ]
+
+
+# ---------------------------------------------------------------------------
+# R1 guarded-by
+# ---------------------------------------------------------------------------
+
+R1_BAD_GLOBAL = """
+    import threading
+
+    _LOCK = threading.Lock()
+    _CACHE = {}  # celint: guarded-by(_LOCK)
+
+
+    def put(key, value):
+        _CACHE[key] = value
+"""
+
+R1_BAD_METHODS = """
+    import threading
+
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []  # celint: guarded-by(self._lock)
+
+        def bad_append(self, x):
+            self._items.append(x)
+
+        def bad_rebind(self):
+            self._items = []
+
+        def bad_augment(self, xs):
+            self._items += xs
+"""
+
+R1_GOOD = """
+    import threading
+
+    _LOCK = threading.Lock()
+    _CACHE = {}  # celint: guarded-by(_LOCK)
+
+
+    def put(key, value):
+        with _LOCK:
+            _CACHE[key] = value
+
+
+    def drop(key):
+        with _LOCK:
+            del _CACHE[key]
+
+
+    def _evict_locked(key):
+        # caller-holds-lock convention: *_locked names are exempt
+        _CACHE.pop(key, None)
+
+
+    def read(key):
+        return _CACHE.get(key)  # reads are not mutations
+
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []  # celint: guarded-by(self._lock)
+
+        def good_append(self, x):
+            with self._lock:
+                self._items.append(x)
+"""
+
+
+def test_r1_fires_on_unlocked_global_mutation():
+    out = _lint(R1_BAD_GLOBAL)
+    assert _ids(out) == ["guarded-by"], out
+
+
+def test_r1_fires_on_each_unlocked_method_mutation():
+    out = [f for f in _lint(R1_BAD_METHODS) if f.rule == "guarded-by"]
+    assert len(out) == 3, out  # append, rebind, augmented assign
+
+
+def test_r1_quiet_on_locked_mutations_and_reads():
+    assert _ids(_lint(R1_GOOD)) == []
+
+
+def test_r1_flags_dangling_annotation():
+    out = _lint(
+        """
+        # celint: guarded-by(_LOCK)
+        print("no assignment here")
+        """
+    )
+    assert _ids(out) == ["guarded-by"]
+
+
+# ---------------------------------------------------------------------------
+# R2 no-handrolled-cache
+# ---------------------------------------------------------------------------
+
+R2_BAD = """
+    from collections import OrderedDict
+
+    _CACHE = OrderedDict()
+    _MAX = 16
+
+
+    def put(key, value):
+        _CACHE[key] = value
+        _CACHE.move_to_end(key)
+        while len(_CACHE) > _MAX:
+            _CACHE.popitem(last=False)
+
+
+    def put_fifo(cache, key, value):
+        while len(cache) >= _MAX:
+            cache.pop(next(iter(cache)))
+        cache[key] = value
+"""
+
+R2_GOOD = """
+    from functools import lru_cache
+
+    from celestia_tpu.utils.lru import LruCache
+
+    _CACHE = LruCache("fixture", 16)
+
+
+    def put(key, value):
+        _CACHE.put(key, value)
+
+
+    @lru_cache(maxsize=None)
+    def compiled(k):
+        # functools memoization of compiled programs is not the pattern
+        return k
+
+
+    def unbounded_index(d, key, value):
+        d[key] = value  # a plain dict with no eviction loop is fine
+"""
+
+
+def test_r2_fires_on_every_handrolled_fragment():
+    got = _ids(_lint(R2_BAD))
+    # OrderedDict import + move_to_end + while-evict + popitem (inside the
+    # loop) + while-evict FIFO + pop(next(iter()))
+    assert got.count("no-handrolled-cache") >= 5, got
+
+
+def test_r2_quiet_on_lru_cache_and_plain_dicts():
+    assert _ids(_lint(R2_GOOD)) == []
+
+
+def test_r2_exempts_the_sanctioned_module():
+    out = lint_source(
+        "from collections import OrderedDict\n",
+        "celestia_tpu/utils/lru.py",
+    )
+    assert _ids(out) == []
+
+
+# ---------------------------------------------------------------------------
+# R3 consensus-determinism
+# ---------------------------------------------------------------------------
+
+R3_BAD = """
+    import os
+    import random
+    import time as _time
+
+    import numpy as np
+
+
+    def stamp():
+        return _time.time(), _time.time_ns()
+
+
+    def entropy():
+        return os.urandom(32), random.random(), np.random.default_rng()
+
+
+    def fold(items):
+        out = b""
+        for x in set(items):
+            out += x
+        return out
+"""
+
+R3_GOOD_SAME_CODE_OUTSIDE_CONSENSUS = R3_BAD
+
+R3_GOOD = """
+    from celestia_tpu.utils.telemetry import clock
+
+
+    def stamp():
+        return clock()  # the sanctioned telemetry channel
+
+
+    def fold(items):
+        out = b""
+        for x in sorted(set(items)):
+            out += x
+        return out
+"""
+
+
+def test_r3_fires_in_state_and_da():
+    for rel in ("celestia_tpu/state/fixture.py", "celestia_tpu/da/fixture.py"):
+        got = _ids(_lint(R3_BAD, rel))
+        # time.time, time.time_ns, os.urandom, random.random,
+        # np.random.default_rng, set iteration
+        assert got.count("consensus-determinism") == 6, (rel, got)
+
+
+def test_r3_scoped_to_consensus_modules():
+    out = _lint(
+        R3_GOOD_SAME_CODE_OUTSIDE_CONSENSUS, "celestia_tpu/node/fixture.py"
+    )
+    assert _ids(out) == []
+
+
+def test_r3_quiet_on_sanctioned_clock_and_sorted_sets():
+    assert _ids(_lint(R3_GOOD, "celestia_tpu/state/fixture.py")) == []
+
+
+def test_r3_allow_with_reason_suppresses():
+    src = """
+        import numpy as np
+
+        # celint: allow(consensus-determinism) — seeded sampling RNG
+        _RNG = np.random.default_rng(7)
+    """
+    out = _lint(src, "celestia_tpu/da/fixture.py")
+    assert _ids(out) == []
+    suppressed = [f for f in out if f.suppressed]
+    assert len(suppressed) == 1
+    assert suppressed[0].suppress_reason == "seeded sampling RNG"
+
+
+# ---------------------------------------------------------------------------
+# R4 hostpool-discipline
+# ---------------------------------------------------------------------------
+
+R4_BAD = """
+    from celestia_tpu.utils import native
+
+
+    def extend(square):
+        return native.extend_block_cpu(square, nthreads=4)
+
+
+    def helper(x, nthreads=2):
+        return x
+"""
+
+R4_GOOD = """
+    from celestia_tpu.utils import hostpool, native
+
+
+    def extend(square, nthreads=None):
+        return native.extend_block_cpu(square, nthreads=nthreads)
+
+
+    def extend_explicit(square):
+        return native.extend_block_cpu(
+            square, nthreads=hostpool.cpu_threads()
+        )
+"""
+
+
+def test_r4_fires_on_literal_thread_counts():
+    got = _ids(_lint(R4_BAD))
+    assert got == ["hostpool-discipline", "hostpool-discipline"], got
+
+
+def test_r4_quiet_on_pool_sourced_counts():
+    assert _ids(_lint(R4_GOOD)) == []
+
+
+# ---------------------------------------------------------------------------
+# directive hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_allow_without_reason_is_a_finding():
+    out = _lint(
+        """
+        x = 1  # celint: allow(hostpool-discipline)
+        """
+    )
+    assert _ids(out) == ["bad-suppression"]
+
+
+def test_unused_allow_is_a_finding():
+    out = _lint(
+        """
+        x = 1  # celint: allow(hostpool-discipline) — stale excuse
+        """
+    )
+    assert _ids(out) == ["unused-suppression"]
+
+
+def test_comment_line_allow_attaches_to_next_statement():
+    src = """
+        from celestia_tpu.utils import native
+
+
+        def extend(square):
+            return native.extend_block_cpu(
+                square,
+                # celint: allow(hostpool-discipline) — fixture reason
+                nthreads=4,
+            )
+    """
+    out = _lint(src)
+    assert _ids(out) == []
+    assert any(f.suppressed for f in out)
+
+
+def test_rule_aliases_resolve():
+    assert {ALIASES[a] for a in ("r1", "r2", "r3", "r4")} == set(REGISTRY)
+
+
+def test_rules_subset_runs_only_named_rules():
+    out = _lint(R2_BAD, rules=["r3"])
+    assert _ids(out) == []  # R2 findings only exist when R2 is enabled
+
+
+# ---------------------------------------------------------------------------
+# the real gate
+# ---------------------------------------------------------------------------
+
+
+def test_repo_tree_lints_clean_with_all_rules():
+    findings = run_lint()  # whole celestia_tpu package, all four rules
+    bad = failing(findings)
+    assert not bad, "celint findings:\n" + "\n".join(f.format() for f in bad)
+
+
+def test_every_tree_suppression_is_explained():
+    findings = run_lint()
+    for f in findings:
+        if f.suppressed:
+            assert f.suppress_reason, f.format()

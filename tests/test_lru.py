@@ -1,0 +1,244 @@
+"""utils/lru.LruCache: the ONE bounded cache every subsystem shares.
+
+Covers the single-threaded contract (recency, bounding by entries and by
+bytes, counters, atomic compound ops), the registry/budget telemetry
+surface, and a multithreaded hammer asserting the internal invariants a
+torn OrderedDict would break.
+"""
+
+import threading
+
+import pytest
+
+from celestia_tpu.utils import lru
+from celestia_tpu.utils.lru import LruCache, bytes_len_weigher, nbytes_weigher
+
+
+def _cache(n=4, **kw):
+    # register=False keeps unit-test caches out of the process registry
+    return LruCache("test", n, register=False, **kw)
+
+
+def test_get_put_and_lru_eviction_order():
+    c = _cache(3)
+    for i in range(3):
+        c.put(i, str(i))
+    assert c.get(0) == "0"  # refresh 0: now 1 is least recent
+    c.put(3, "3")
+    assert 1 not in c
+    assert [k for k in (0, 2, 3) if k in c] == [0, 2, 3]
+    assert c.evictions == 1
+
+
+def test_counters_and_stats():
+    c = _cache(4)
+    assert c.get("missing") is None
+    c.put("a", 1)
+    assert c.get("a") == 1
+    c.put("a", 2)  # replacement, not a fresh put
+    s = c.stats()
+    assert (s["hits"], s["misses"], s["puts"], s["replacements"]) == (1, 1, 1, 1)
+    assert s["hit_rate"] == 0.5
+    assert len(c) == s["entries"] == 1
+
+
+def test_peek_skips_counters_but_refreshes_recency():
+    c = _cache(2)
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.peek("a") == 1
+    s = c.stats()
+    assert s["hits"] == 0 and s["misses"] == 0
+    c.put("c", 3)  # "b" is now least recent despite being inserted later
+    assert "a" in c and "b" not in c
+
+
+def test_get_touch_false_preserves_fifo_window():
+    """The decided log's contract: puts in height order + touch=False
+    reads = a contiguous sliding window; re-reading an old entry never
+    saves it from eviction at the expense of a mid-window one."""
+    c = _cache(3)
+    for h in (1, 2, 3):
+        c.put(h, h * 10)
+    assert c.get(1, touch=False) == 10  # counted as a hit...
+    assert c.stats()["hits"] == 1
+    c.put(4, 40)
+    assert 1 not in c  # ...but evicted anyway: lowest height goes first
+    assert c.keys() == [2, 3, 4]
+
+
+def test_get_many_put_many_batch_semantics():
+    c = _cache(8)
+    c.put_many([("a", 1), ("b", 2), ("c", 3)])
+    assert c.get_many(["a", "x", "c"]) == [1, None, 3]
+    s = c.stats()
+    assert s["puts"] == 3 and s["hits"] == 2 and s["misses"] == 1
+    # batch reads refresh recency like get()
+    c2 = _cache(2)
+    c2.put_many([("a", 1), ("b", 2)])
+    c2.get_many(["a"])
+    c2.put("c", 3)
+    assert "a" in c2 and "b" not in c2
+
+
+def test_contains_does_not_refresh_recency():
+    c = _cache(2)
+    c.put("a", 1)
+    c.put("b", 2)
+    assert "a" in c
+    c.put("c", 3)
+    assert "a" not in c  # membership check did not save it
+
+
+def test_byte_bounding_with_weigher():
+    c = _cache(100, weigher=lambda k, v: len(v), max_bytes=10)
+    c.put("a", b"xxxx")
+    c.put("b", b"yyyy")
+    assert c.approx_bytes() == 8
+    c.put("c", b"zzzz")  # 12 bytes > 10: evict until within budget
+    assert c.approx_bytes() <= 10
+    assert "a" not in c
+    assert c.evictions == 1
+
+
+def test_byte_bound_never_evicts_to_empty():
+    c = _cache(100, weigher=lambda k, v: len(v), max_bytes=2)
+    c.put("big", b"x" * 100)  # over budget but len==1: must stay resident
+    assert "big" in c
+
+
+def test_replacement_updates_weight_accounting():
+    c = _cache(4, weigher=lambda k, v: len(v))
+    c.put("a", b"xx")
+    c.put("a", b"xxxxxx")
+    assert c.approx_bytes() == 6
+    assert c.pop("a") == b"xxxxxx"
+    assert c.approx_bytes() == 0
+
+
+def test_add_if_absent_is_membership_add():
+    c = _cache(4)
+    assert c.add_if_absent("k") is True
+    assert c.add_if_absent("k") is False
+    assert c.hits == 1 and c.misses == 1
+
+
+def test_get_or_put_runs_factory_once():
+    c = _cache(4)
+    calls = []
+    assert c.get_or_put("k", lambda: calls.append(1) or "v") == "v"
+    assert c.get_or_put("k", lambda: calls.append(1) or "other") == "v"
+    assert calls == [1]
+
+
+def test_set_max_entries_trims_immediately():
+    c = _cache(8)
+    for i in range(8):
+        c.put(i, i)
+    c.set_max_entries(3)
+    assert len(c) == 3
+    assert all(k in c for k in (5, 6, 7))  # most recent survive
+
+
+def test_clear_resets_entries_and_counters():
+    c = _cache(4)
+    c.put("a", 1)
+    c.get("a")
+    c.clear()
+    s = c.stats()
+    assert len(c) == 0 and s["hits"] == 0 and s["approx_bytes"] == 0
+
+
+def test_broken_weigher_never_breaks_the_cache():
+    def bad(k, v):
+        raise RuntimeError("weigher bug")
+
+    c = _cache(4, weigher=bad)
+    c.put("a", 1)
+    assert c.get("a") == 1 and c.approx_bytes() == 0
+
+
+def test_shared_weighers():
+    assert bytes_len_weigher(b"12345678", b"xx") == 10
+    assert nbytes_weigher(b"k", b"1234") == 36  # 4 + tuple overhead
+
+    class FakeEds:
+        _shares = type("A", (), {"shape": (4, 4, 512)})()
+
+    # weighs by SHAPE so a device-resident EDS is never fetched
+    assert nbytes_weigher(b"k", FakeEds()) == 4 * 4 * 512 + 32
+
+
+def test_registry_aggregates_by_name():
+    a = LruCache("agg_fixture", 4)
+    b = LruCache("agg_fixture", 4)
+    a.put(1, b"x")
+    b.put(2, b"y")
+    b.get(2)
+    stats = lru.registry_stats()
+    agg = stats["caches"]["agg_fixture"]
+    assert agg["instances"] >= 2
+    assert agg["entries"] >= 2
+    assert agg["hits"] >= 1
+    assert stats["total_approx_bytes"] >= 0
+
+
+def test_registry_drops_dead_caches():
+    import gc
+
+    c = LruCache("ephemeral_fixture", 4)
+    c.put(1, 1)
+    assert any(x.name == "ephemeral_fixture" for x in lru.live_caches())
+    del c
+    gc.collect()
+    assert not any(x.name == "ephemeral_fixture" for x in lru.live_caches())
+
+
+def test_budget_reporting(monkeypatch):
+    monkeypatch.setenv("CELESTIA_TPU_CACHE_BUDGET_MB", "0.00001")  # ~10 bytes
+    keeper = LruCache("budget_fixture", 4, weigher=lambda k, v: 64)
+    keeper.put("k", "v")
+    stats = lru.registry_stats()
+    assert stats["budget_bytes"] == int(0.00001 * 1024 * 1024)
+    assert stats["over_budget"] is True
+    monkeypatch.delenv("CELESTIA_TPU_CACHE_BUDGET_MB")
+    assert lru.registry_stats()["budget_bytes"] is None
+
+
+def test_concurrent_hammer_preserves_invariants():
+    """8 threads x mixed put/get/add_if_absent/pop over overlapping keys
+    against a tiny cache: no exceptions, bounds respected, and the byte
+    accounting still equals the sum of resident weights afterwards."""
+    c = _cache(16, weigher=lambda k, v: 8)
+    errors = []
+    barrier = threading.Barrier(8)
+
+    def worker(tid):
+        try:
+            barrier.wait(timeout=30)
+            for i in range(400):
+                k = (tid * 7 + i) % 48
+                op = i % 4
+                if op == 0:
+                    c.put(k, i)
+                elif op == 1:
+                    c.get(k)
+                elif op == 2:
+                    c.add_if_absent(k, i)
+                else:
+                    c.pop(k)
+                assert len(c) <= 16
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors[:3]
+    with c._lock:
+        assert c._bytes == sum(w for _, w in c._entries.values())
+        assert len(c._entries) <= 16
+    s = c.stats()
+    assert s["hits"] + s["misses"] <= 8 * 400 * 3  # sane counter totals

@@ -131,3 +131,65 @@ def test_nonce_recovery_under_external_interference():
     res = s2.submit_tx([MsgSend(s2.address, sink, 20)])
     assert res.code == 0, res.log
     assert node.app.bank.balance(sink) == 30
+
+
+def test_commitment_cache_concurrent_hammer():
+    """Regression for the celint R1 founding bug: _COMMITMENT_CACHE shipped
+    as an UNLOCKED plain dict mutated from pooled threads (warm_commitments
+    batches + per-blob create_commitment during FilterTxs/ProcessProposal).
+    Hammer the migrated shared-LRU cache from many threads with a tiny cap
+    so eviction churns constantly, and assert every commitment returned
+    under contention equals the serial recompute."""
+    from celestia_tpu.da.inclusion import (
+        _COMMITMENT_CACHE,
+        create_commitment,
+        warm_commitments,
+    )
+
+    blobs = [
+        Blob(Namespace.v0(b"hammer-%02d" % i), bytes([i + 1]) * (300 + 37 * i))
+        for i in range(24)
+    ]
+    old_cap = _COMMITMENT_CACHE.max_entries
+    _COMMITMENT_CACHE.clear()
+    try:
+        expected = [create_commitment(b) for b in blobs]
+        _COMMITMENT_CACHE.clear()
+        _COMMITMENT_CACHE.set_max_entries(6)  # force eviction under load
+        errors = []
+        barrier = threading.Barrier(N_THREADS)
+
+        def worker(tid):
+            try:
+                barrier.wait(timeout=30)
+                for rep in range(6):
+                    if (tid + rep) % 3 == 0:
+                        # the batch path pooled proposal legs use
+                        warm_commitments(blobs)
+                    order = list(range(len(blobs)))
+                    # deterministic per-thread order, distinct across threads
+                    off = (tid * 5 + rep) % len(order)
+                    for i in order[off:] + order[:off]:
+                        got = create_commitment(blobs[i])
+                        assert got == expected[i], (
+                            f"thread {tid} rep {rep} blob {i}: commitment "
+                            f"diverged under concurrency"
+                        )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(t,))
+            for t in range(N_THREADS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors[:3]
+        assert len(_COMMITMENT_CACHE) <= 6
+        stats = _COMMITMENT_CACHE.stats()
+        assert stats["evictions"] > 0  # the cap really churned
+    finally:
+        _COMMITMENT_CACHE.set_max_entries(old_cap)
+        _COMMITMENT_CACHE.clear()

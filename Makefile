@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test native native-sanitize native-sanitize-tsan native-sanitize-asan bench
+.PHONY: lint test chaos native native-sanitize native-sanitize-tsan native-sanitize-asan bench
 
 ## celint: concurrency & determinism static analysis (exit 1 on findings)
 lint:
@@ -13,6 +13,13 @@ lint:
 ## tier-1 test suite (same selection the CI driver runs)
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
+
+## seeded chaos suite: deterministic fault injection + recovery scenarios
+## (fixed seeds; the same subset runs inside tier-1 via the plain test
+## target — this entry is the focused robustness gate).  Reproduce any
+## failure with CELESTIA_TPU_CHAOS_SEED / the seed in the test id.
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_chaos.py -q -m 'not slow' -p no:cacheprovider
 
 ## (re)build the production native library
 native:

@@ -626,6 +626,54 @@ def _unified_cache_stats() -> dict:
     }
 
 
+def _fault_recovery_stats() -> dict:
+    """Injected-fault recovery latency (PR 7 robustness trajectory): a
+    simulated gossip fetch driven through the unified RetryPolicy with
+    the gossip.fetch point armed at a 10% fail rate — p50/p99 of the
+    per-fetch wall time INCLUDING the seeded backoff sleeps, so the
+    number is the latency an actual catch-up pull pays when one peer in
+    ten flakes.  Fully seeded: the schedule and the jitter reproduce."""
+    from celestia_tpu.utils import faults
+
+    rate = 0.10
+    n = 400
+    faults.arm("gossip.fetch", "fail_rate", rate=rate, seed=1234)
+    lat = []
+    recovered = 0
+    try:
+        for i in range(n):
+            policy = faults.RetryPolicy(
+                attempts=6, base_s=0.001, cap_s=0.01, seed=i
+            )
+            t0 = time.perf_counter()
+            policy.run(lambda: faults.fire("gossip.fetch"))
+            lat.append((time.perf_counter() - t0) * 1000.0)
+        armed = faults.armed_points()["gossip.fetch"]
+        recovered = armed["injected"]
+    finally:
+        faults.disarm("gossip.fetch")
+    lat.sort()
+    return {
+        "fault_rate": rate,
+        "fetches": n,
+        "injected_faults_recovered": recovered,
+        "gossip_fetch_p50_ms": round(lat[len(lat) // 2], 3),
+        "gossip_fetch_p99_ms": round(lat[int(len(lat) * 0.99)], 3),
+    }
+
+
+def _fault_stats_extras() -> dict:
+    """extras.fault_stats: recovery-latency leg + the process-wide
+    injection/swallow/degradation counters (BASELINE.md)."""
+    from celestia_tpu.utils import faults
+
+    out = {"recovery": _fault_recovery_stats()}
+    s = faults.fault_stats()
+    out["notes"] = s["notes"]
+    out["degradations"] = s["degradations"]
+    return out
+
+
 def _host_repair_ms(k: int):
     """Host-only repair (the light-client/DAS path — no accelerator):
     25% withheld, root-verified.  Under the leopard codec this runs the
@@ -761,6 +809,12 @@ def _host_only_main():
         extras["row_memo"] = _row_memo_reuse(K)
     except Exception as e:
         extras["row_memo_error"] = repr(e)[:200]
+    try:
+        # robustness trajectory: injected-fault recovery latency + the
+        # process-wide injection/swallow/degradation counters
+        extras["fault_stats"] = _fault_stats_extras()
+    except Exception as e:
+        extras["fault_stats_error"] = repr(e)[:200]
     try:
         # LAST: snapshot after every leg has exercised its caches
         extras["unified_caches"] = _unified_cache_stats()
@@ -907,6 +961,12 @@ def main():
             extras["dah_128_fixture_match"] = bool(_dah_128_fixture_match())
     except Exception as e:
         extras["dah_128_fixture_error"] = repr(e)[:200]
+    try:
+        # robustness trajectory: injected-fault recovery latency + the
+        # process-wide injection/swallow/degradation counters
+        extras["fault_stats"] = _fault_stats_extras()
+    except Exception as e:
+        extras["fault_stats_error"] = repr(e)[:200]
     try:
         # LAST: snapshot after every leg has exercised its caches
         extras["unified_caches"] = _unified_cache_stats()

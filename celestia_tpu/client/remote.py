@@ -10,7 +10,6 @@ ConfirmTx poll :365-395).
 from __future__ import annotations
 
 import json
-import time
 from typing import Optional
 
 import grpc
@@ -214,6 +213,38 @@ class RemoteNode:
         )
         return list(out.get("peers", []))
 
+    def das_sample(self, height: int, row: int, col: int, *, policy=None):
+        """One DAS cell + proof from the node's serving plane.
+
+        A shed response (load shedding or an injected serving fault) is
+        retried through the unified RetryPolicy, honoring the server's
+        ``retry_after_ms`` pushback; returns the sample dict
+        ``{"proof": ..., "data_root": ...}``.  The final shed attempt
+        raises :class:`faults.Overloaded` — the caller's signal that the
+        plane is saturated, not broken."""
+        from celestia_tpu.utils import faults
+
+        if policy is None:
+            policy = faults.RetryPolicy(
+                attempts=6, base_s=0.02, cap_s=0.25,
+                deadline_s=self.timeout_s,
+            )
+
+        def attempt():
+            out = self._call_json(
+                "DasSample", {"height": height, "row": row, "col": col}
+            )
+            if out.get("shed"):
+                raise faults.Overloaded(
+                    out.get("log") or "DAS serving plane shed the request",
+                    retry_after_ms=float(out.get("retry_after_ms", 25.0)),
+                )
+            if out.get("code"):
+                raise RemoteError(out.get("log", "das sample failed"))
+            return out
+
+        return policy.run(attempt, retry_on=(faults.Overloaded,))
+
     def genesis(self):
         """The peer's genesis document, or None (download-genesis)."""
         out = self._call_json("Genesis", {})
@@ -251,8 +282,8 @@ class RemoteNode:
         return bytes.fromhex(data)
 
     def wait_for_height(self, h: int, timeout_s: float = 60.0) -> None:
-        deadline = time.time() + timeout_s
-        while self.height < h:
-            if time.time() > deadline:
-                raise TimeoutError(f"height {h} not reached in {timeout_s}s")
-            time.sleep(0.05)
+        from celestia_tpu.utils.faults import RetryPolicy
+
+        RetryPolicy(base_s=0.05, cap_s=0.2, deadline_s=timeout_s).poll(
+            lambda: self.height >= h, what=f"height {h}"
+        )

@@ -15,7 +15,6 @@ nonce-mismatch recovery and re-signing (:268-309), ConfirmTx polling
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
@@ -162,17 +161,23 @@ class Signer:
         timeout_s: float = DEFAULT_CONFIRM_TIMEOUT_S,
         poll_interval_s: float = DEFAULT_POLL_INTERVAL_S,
     ) -> SubmitResult:
-        """Poll until the tx lands in a block (signer.go:365-395)."""
-        deadline = time.time() + timeout_s
-        while time.time() < deadline:
-            info = self.node.get_tx(tx_hash)
-            if info is not None:
-                return SubmitResult(
-                    code=info["code"], log=info.get("log", ""),
-                    tx_hash=tx_hash, height=info["height"],
-                )
-            time.sleep(poll_interval_s)
-        raise TimeoutError(f"tx {tx_hash.hex()} not confirmed in {timeout_s}s")
+        """Poll until the tx lands in a block (signer.go:365-395), on the
+        unified RetryPolicy (utils/faults.py): jittered poll intervals,
+        hard deadline budget, reproducible under a chaos seed."""
+        from celestia_tpu.utils.faults import RetryPolicy
+
+        info = RetryPolicy(
+            base_s=poll_interval_s,
+            cap_s=max(poll_interval_s * 2, 0.25),
+            deadline_s=timeout_s,
+        ).poll(
+            lambda: self.node.get_tx(tx_hash),
+            what=f"tx {tx_hash.hex()} confirmation",
+        )
+        return SubmitResult(
+            code=info["code"], log=info.get("log", ""),
+            tx_hash=tx_hash, height=info["height"],
+        )
 
     @property
     def sequence(self) -> int:

@@ -515,10 +515,21 @@ def extend_and_header(
         if memoized is not None:
             return memoized
     if _host_native_available():
-        eds, dah = _extend_and_header_host(square)
-        if digests is not None:
-            _memo_populate(k, digests, eds.shares, dah.row_roots)
-        return eds, dah
+        try:
+            eds, dah = _extend_and_header_host(square)
+        except Exception as e:
+            # graceful degradation (specs/robustness.md): a native fault
+            # mid-run pins the library OFF (one-way; loud) and this very
+            # call falls through to the table-GF jax path below — byte-
+            # identical output, so the block being extended still commits
+            # the same data root it would have cold
+            from celestia_tpu.utils import native as _native
+
+            _native.poison(f"extend_and_header native leg failed: {e!r}")
+        else:
+            if digests is not None:
+                _memo_populate(k, digests, eds.shares, dah.row_roots)
+            return eds, dah
     eds_d, row_roots, col_roots, data_root = _extend_and_roots_fn(k, _active_codec())(
         jnp.asarray(square)
     )
@@ -581,9 +592,17 @@ def new_data_availability_header(eds: ExtendedDataSquare) -> DataAvailabilityHea
     Host regime: the 4k independent NMT trees shard across the process
     worker pool (ops/nmt.py eds_nmt_roots_host) instead of compiling the
     XLA CPU program — same bytes, minutes less latency at k=128."""
+    roots = None
     if _host_native_available():
-        roots = nmt_ops.eds_nmt_roots_host(eds.shares)
-    else:
+        try:
+            roots = nmt_ops.eds_nmt_roots_host(eds.shares)
+        except Exception as e:
+            # same one-way degradation as extend_and_header: poison the
+            # native leg and recompute on the jax path (identical bytes)
+            from celestia_tpu.utils import native as _native
+
+            _native.poison(f"eds_nmt_roots native leg failed: {e!r}")
+    if roots is None:
         roots = np.asarray(_eds_nmt_roots_jit(jnp.asarray(eds.shares)))
     rows = tuple(roots[0, i].tobytes() for i in range(roots.shape[1]))
     cols = tuple(roots[1, i].tobytes() for i in range(roots.shape[1]))

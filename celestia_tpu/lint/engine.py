@@ -253,6 +253,7 @@ ALIASES = {
     "r2": "no-handrolled-cache",
     "r3": "consensus-determinism",
     "r4": "hostpool-discipline",
+    "r5": "sanctioned-retry",
 }
 
 

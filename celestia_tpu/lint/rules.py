@@ -15,6 +15,12 @@ afford to re-lose by review drift:
 * ``hostpool-discipline`` (R4) — native ``nthreads`` always comes from
   utils/hostpool (or None, which resolves there); a literal thread count
   re-creates the oversubscription the process-wide pool exists to end.
+* ``sanctioned-retry`` (R5) — bare ``except:``, ``except Exception:
+  pass``-style swallows and hand-rolled ``time.sleep`` retry loops are
+  forbidden outside utils/faults.py: failures are recorded via
+  ``faults.note`` or propagate, and every sleep-retry goes through the
+  one RetryPolicy (seeded backoff, deadline budgets) so recovery paths
+  stay testable under the chaos harness.
 """
 
 from __future__ import annotations
@@ -451,3 +457,103 @@ def _literal_int(node: ast.AST) -> bool:
         and isinstance(node.value, int)
         and not isinstance(node.value, bool)
     )
+
+
+# ---------------------------------------------------------------------------
+# R5: sanctioned-retry
+# ---------------------------------------------------------------------------
+
+# the one module allowed to sleep in loops / implement retry primitives
+_RETRY_SANCTIONED = "celestia_tpu/utils/faults.py"
+
+# exception names whose silent swallow is a finding (anything this broad
+# hides real failures; narrower types document what is being tolerated)
+_BROAD_EXCEPTIONS = {"Exception", "BaseException"}
+
+
+@register
+class SanctionedRetryRule(Rule):
+    id = "sanctioned-retry"
+    summary = "no silent exception swallows or hand-rolled sleep retry loops"
+    doc = (
+        "Outside utils/faults.py flags: (a) bare `except:`; (b) an "
+        "`except Exception`/`except BaseException` handler whose body is "
+        "only pass/continue — a silently swallowed failure (record it "
+        "with faults.note(<point>, e) or re-raise); (c) a time.sleep "
+        "call lexically inside a for/while loop — a hand-rolled retry/"
+        "poll loop (use faults.RetryPolicy: seeded decorrelated-jitter "
+        "backoff + deadline budget).  Deliberate pacing sleeps carry an "
+        "allow with a reason."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.relpath == _RETRY_SANCTIONED:
+            return
+        sleep_names = _sleep_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler):
+                yield from self._check_handler(ctx, node)
+            elif isinstance(node, ast.Call) and _is_sleep_call(
+                node, sleep_names
+            ):
+                if any(
+                    isinstance(anc, (ast.For, ast.While, ast.AsyncFor))
+                    for anc in ctx.ancestors(node)
+                ):
+                    yield Finding(
+                        self.id, ctx.relpath, node.lineno, node.col_offset,
+                        "time.sleep inside a loop is a hand-rolled retry/"
+                        "poll — use utils/faults.RetryPolicy (run/poll) "
+                        "or carry an allow naming the pacing reason",
+                    )
+
+    def _check_handler(
+        self, ctx: ModuleContext, node: ast.ExceptHandler
+    ) -> Iterator[Finding]:
+        if node.type is None:
+            yield Finding(
+                self.id, ctx.relpath, node.lineno, node.col_offset,
+                "bare `except:` swallows KeyboardInterrupt/SystemExit too "
+                "— name the exception type",
+            )
+            return
+        if not _catches_broad(node.type):
+            return
+        if all(isinstance(s, (ast.Pass, ast.Continue)) for s in node.body):
+            yield Finding(
+                self.id, ctx.relpath, node.lineno, node.col_offset,
+                "`except Exception` with a pass/continue body silently "
+                "drops the failure — record it with "
+                "faults.note(<point>, e) or re-raise",
+            )
+
+
+def _catches_broad(t: ast.AST) -> bool:
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD_EXCEPTIONS
+    if isinstance(t, ast.Attribute):
+        return t.attr in _BROAD_EXCEPTIONS
+    if isinstance(t, ast.Tuple):
+        return any(_catches_broad(e) for e in t.elts)
+    return False
+
+
+def _sleep_aliases(tree: ast.Module) -> Set[str]:
+    """Local names bound to time.sleep via `from time import sleep`."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _is_sleep_call(node: ast.Call, sleep_names: Set[str]) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in sleep_names
+    # <any alias>.sleep(...): time is routinely imported as _time; a
+    # non-time object with a .sleep() method would be novel enough in
+    # this tree to deserve the allow it would need
+    return isinstance(f, ast.Attribute) and f.attr == "sleep"

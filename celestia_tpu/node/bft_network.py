@@ -14,6 +14,14 @@ clocks lapse; here a timeout becomes DUE when the message queue drains
 without a decision — same observable semantics (timeouts only matter
 when progress stalls), fully reproducible.
 
+Relation to the chaos harness (specs/robustness.md): this harness
+injects faults at the TRANSPORT level (partitions, drop rules, crashed
+validators) with determinism coming from the quiescence-driven pump; the
+utils/faults.py registry injects at the SUBSYSTEM level (native codec,
+hostpool, state-sync chunks, serving plane) with determinism coming from
+seeded schedules.  The two compose: a BFTNetwork scenario can run with
+fault points armed, and neither layer sleeps or draws ambient entropy.
+
 Reference role: celestia-core consensus + p2p gossip driving N nodes
 (SURVEY §2.2/§2.3); replaces the central sequencing of
 node/network.py's legacy driver.

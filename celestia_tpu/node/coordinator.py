@@ -38,6 +38,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
 from celestia_tpu.node.network import ConsensusFailure, RoundResult, Vote
+from celestia_tpu.utils import faults
 
 
 @dataclass
@@ -259,7 +260,8 @@ class BFTRelay:
         for p in self.peers:
             try:
                 out.append(int(p.client.status()["height"]))
-            except Exception:
+            except Exception as e:
+                faults.note("relay.status", e)
                 continue  # unreachable peers just don't report
         return out
 
@@ -271,7 +273,8 @@ class BFTRelay:
         for p in self.peers:
             try:
                 peer_heights.append((p, int(p.client.status()["height"])))
-            except Exception:
+            except Exception as e:
+                faults.note("relay.status", e)
                 continue
         if not peer_heights:
             return
@@ -283,7 +286,8 @@ class BFTRelay:
                 for src in sources:
                     try:
                         d = src.client.bft_decided(h + 1)
-                    except Exception:
+                    except Exception as e:
+                        faults.note("relay.catchup", e)
                         continue
                     if d is None:
                         continue
@@ -301,16 +305,19 @@ class BFTRelay:
         """Drive one height to a decision on every reachable peer;
         returns the new height."""
         heights = self._heights()
-        retries = 0
-        while not heights:
-            retries += 1
-            if retries > 30:
+        if not heights:
+            # unified retry layer (utils/faults.py): jittered 0.5-2 s
+            # polls under a 30 s budget replace the hand-rolled
+            # sleep(1.0) loop this relay shipped with
+            try:
+                heights = faults.RetryPolicy(
+                    base_s=0.5, cap_s=2.0, deadline_s=30.0
+                ).poll(self._heights, what="any validator peer")
+            except TimeoutError:
                 raise RuntimeError(
                     "no validator peer reachable: "
                     + ", ".join(p.name for p in self.peers)
                 )
-            _time.sleep(1.0)
-            heights = self._heights()
         start = max(heights)
         if min(heights) < start:
             self._catch_up_laggards(start)
@@ -318,8 +325,8 @@ class BFTRelay:
         for peer in self.peers:
             try:
                 peer.client.bft_start(target)
-            except Exception:
-                pass  # unreachable peers miss the round
+            except Exception as e:
+                faults.note("relay.start", e)  # unreachable: misses the round
         steps = 0
         pending_timeouts: List[tuple] = []  # (peer, {step,height,round})
         while True:
@@ -328,7 +335,8 @@ class BFTRelay:
             for peer in self.peers:
                 try:
                     drained.append((peer, peer.client.bft_drain()))
-                except Exception:
+                except Exception as e:
+                    faults.note("relay.drain", e)
                     continue
             for sender, d in drained:
                 pending_timeouts.extend((sender, t) for t in d["timeouts"])
@@ -339,7 +347,8 @@ class BFTRelay:
                             continue
                         try:
                             peer.client.bft_msg(wire)
-                        except Exception:
+                        except Exception as e:
+                            faults.note("relay.forward", e)
                             continue
             if drained and all(d["height"] >= target for _, d in drained):
                 return target
@@ -362,7 +371,8 @@ class BFTRelay:
                         peer.client.bft_timeout(
                             t["step"], t["height"], t["round"]
                         )
-                    except Exception:
+                    except Exception as e:
+                        faults.note("relay.timeout", e)
                         continue
                 pending_timeouts.clear()
             steps += 1

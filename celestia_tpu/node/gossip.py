@@ -56,6 +56,7 @@ import time as _time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from celestia_tpu.utils import faults
 from celestia_tpu.utils.logging import Logger
 from celestia_tpu.utils.lru import LruCache, bytes_len_weigher
 
@@ -146,8 +147,8 @@ class _PeerLink:
         if self._client is not None:
             try:
                 self._client.close()
-            except Exception:
-                pass
+            except Exception as e:
+                faults.note("gossip.link", e)
             self._client = None
 
     def _run(self) -> None:
@@ -208,6 +209,7 @@ class GossipEngine:
         fanout: int = 8,
         max_peers: int = 64,
         pex_interval_s: float = 1.0,
+        chunk_retry_deadline_s: float = 10.0,
         logger=None,
     ):
         self.node = node
@@ -225,7 +227,14 @@ class GossipEngine:
         self._last_pex = 0.0
         self._pex_rr = 0  # round-robin cursor over peers for PEX
         self._catch_up_thread: Optional[threading.Thread] = None
-        self._pull_backoff: Dict[str, float] = {}
+        # per-peer circuit breakers over the catch-up/state-sync pulls
+        # (the unified policy layer, utils/faults.py): one failure opens
+        # the peer for 10 s (the PR 4 cooldown semantics), resource-bound
+        # violations trip it for 60 s via trip()
+        self._breakers = faults.BreakerRegistry(
+            failures_to_open=1, cooldown_s=10.0
+        )
+        self.chunk_retry_deadline_s = chunk_retry_deadline_s
         # drops from links that no longer exist (evicted peers) — keeps
         # dropped_total monotonic for monitoring deltas
         self._dropped_closed = 0
@@ -328,11 +337,11 @@ class GossipEngine:
             if addr in self.peer_addrs:
                 self.peer_addrs.remove(addr)
             self._peer_failures.pop(addr, None)
-            self._pull_backoff.pop(addr, None)
             link = self._links.pop(addr, None)
             if link is not None:
                 self._dropped_closed += link.dropped
         if link is not None:
+            self._breakers.drop(addr)
             link._stop.set()  # worker exits on its own; never join here
             link._event.set()
             # drop the cached catch-up client too: an evicted address
@@ -416,8 +425,10 @@ class GossipEngine:
                 self._behind_hint = h
         try:
             self.node.bft_msg(wire)
-        except Exception:
-            pass  # engine rejects bad messages; a raise must not kill RPC
+        except Exception as e:
+            # engine rejects bad messages; a raise must not kill the RPC
+            # thread — but the failure lands in telemetry, never silently
+            faults.note("gossip.deliver", e)
         self._flood(wire, exclude=sender)
         return True
 
@@ -439,6 +450,8 @@ class GossipEngine:
             # monotonic: includes links already closed by eviction
             "dropped_total": dropped_closed
             + sum(link.dropped for link in links),
+            # per-peer circuit-breaker states over the pull plane
+            "pull_breakers": self._breakers.stats(),
         }
 
     def on_peer_exchange(self, sender: str, peers: List[str]) -> List[str]:
@@ -476,7 +489,8 @@ class GossipEngine:
                 continue
             try:
                 res = self.node.broadcast_tx(raw)
-            except Exception:
+            except Exception as e:
+                faults.note("gossip.txpush", e)
                 continue
             if res.code == 0:
                 self._seen_tx.add(h)
@@ -512,8 +526,12 @@ class GossipEngine:
         while not self._stop.is_set():
             try:
                 self._pump_once()
-            except Exception:
-                pass  # the mesh must survive transient RPC storms
+            except Exception as e:
+                # the mesh must survive transient RPC storms — recorded,
+                # never silently dropped (celint R5 contract)
+                faults.note("gossip.pump", e)
+            # fixed-cadence pump tick, not a retry loop
+            # celint: allow(sanctioned-retry) — the pump's pacing sleep: timers/floods tick at tick_s by design
             _time.sleep(self.tick_s)
 
     def _pump_once(self) -> None:
@@ -527,8 +545,8 @@ class GossipEngine:
         for _, step, height, round_ in due_now:
             try:
                 self.node.bft_timeout(step, height, round_)
-            except Exception:
-                pass
+            except Exception as e:
+                faults.note("gossip.timer", e)
         # 2. start the next height when the current one is decided
         if self.node._bft is not None and (
             now - self._last_start >= self.block_gap_s
@@ -538,8 +556,8 @@ class GossipEngine:
                 try:
                     self.node.bft_start(target)
                     self._last_start = now
-                except Exception:
-                    pass
+                except Exception as e:
+                    faults.note("gossip.start", e)
         # 3. drain own outbox + timeout requests; enqueue floods
         d = self.node.bft_drain()
         for wire in d["outbox"]:
@@ -618,8 +636,8 @@ class GossipEngine:
         if cli is not None:
             try:
                 cli.close()
-            except Exception:
-                pass
+            except Exception as e:
+                faults.note("gossip.link", e)
 
     def _maybe_catch_up(self) -> None:
         """Spawn at most one background catch-up worker when behind.
@@ -641,35 +659,43 @@ class GossipEngine:
         self._catch_up_thread = t
         t.start()
 
+    def _pull_rpc(self, fn, *args):
+        """Every catch-up/state-sync pull RPC funnels through here: the
+        ``gossip.fetch`` fault point lives at the top, so the chaos suite
+        can make any pull flaky without touching peer code."""
+        faults.fire("gossip.fetch")
+        return fn(*args)
+
     def _catch_up(self) -> None:
         """Pull decided blocks we're missing (background worker, direct
-        blocking RPCs).  Unreachable peers get a cooldown so a poisoned
-        address book costs each poll a bounded set of dial attempts.
+        blocking RPCs).  Unreachable peers open their circuit breaker so
+        a poisoned address book costs each poll a bounded set of dial
+        attempts (utils/faults.BreakerRegistry — the unified policy
+        layer; resource-bound violators are tripped for 60 s).
 
         The wire-derived hint only TRIGGERS the check; the pull target
         is the peers' actually-reported best height (rate-limited status
         poll), so a Byzantine validator signing sky-high vote heights
         cannot lock the mesh into a permanent catch-up loop — a hint no
         reachable peer corroborates is discarded."""
-        now = _time.time()
         best = 0
-        with self._lock:
-            backoff = dict(self._pull_backoff)
         peers = [
-            a for a in self._peers_snapshot() if backoff.get(a, 0.0) <= now
+            a for a in self._peers_snapshot() if self._breakers.allow(a)
         ]
         for addr in peers:
             cli = self._pull_client(addr)
             if cli is None:
-                self._set_pull_backoff(addr, 10.0)
+                self._breakers.record_failure(addr)
                 continue
             try:
-                best = max(best, int(cli.status().get("height", 0)))
-                with self._lock:
-                    self._pull_backoff.pop(addr, None)
-            except Exception:
+                best = max(
+                    best, int(self._pull_rpc(cli.status).get("height", 0))
+                )
+                self._breakers.record_ok(addr)
+            except Exception as e:
+                faults.note("gossip.fetch", e)
                 self._drop_pull_client(addr)
-                self._set_pull_backoff(addr, 10.0)
+                self._breakers.record_failure(addr)
         if best <= self.node.height:
             with self._lock:
                 # nobody is actually ahead: the hint was noise
@@ -679,13 +705,15 @@ class GossipEngine:
         for addr in peers:
             if self.node.height >= target:
                 return
+            if not self._breakers.available(addr):
+                continue  # opened by the status poll above
             cli = self._pull_client(addr)
             if cli is None:
-                self._set_pull_backoff(addr, 10.0)
+                self._breakers.record_failure(addr)
                 continue
             try:
                 while self.node.height < target:
-                    d = cli.bft_decided(self.node.height + 1)
+                    d = self._pull_rpc(cli.bft_decided, self.node.height + 1)
                     if d is None:
                         # the peer has pruned past our height: a node
                         # offline longer than the decided-log window
@@ -696,45 +724,86 @@ class GossipEngine:
                         continue
                     if not self.node.bft_catchup(d)[0]:
                         break
-            except Exception:
+            except Exception as e:
+                faults.note("gossip.fetch", e)
                 self._drop_pull_client(addr)
-                self._set_pull_backoff(addr, 10.0)
+                self._breakers.record_failure(addr)
 
-    def _set_pull_backoff(self, addr: str, seconds: float) -> None:
-        """Cool a peer down, under the engine lock — _peer_failed (link
-        worker threads) mutates the same dict, so the catch-up worker
-        must use the same discipline (ADVICE r5)."""
-        with self._lock:
-            self._pull_backoff[addr] = _time.time() + seconds
+    def _alt_snapshot_clients(self, exclude: str, limit: int = 2) -> list:
+        """Up to ``limit`` other reachable peers' pull clients — the
+        re-fetch sources for a chunk the primary served corrupt."""
+        out = []
+        for addr in self._peers_snapshot(exclude=exclude or None):
+            if len(out) >= limit:
+                break
+            if not self._breakers.available(addr):
+                continue
+            cli = self._pull_client(addr)
+            if cli is not None:
+                out.append(cli)
+        return out
 
-    def _fetch_snapshot_chunks(self, cli, meta: dict) -> list:
+    def _fetch_snapshot_chunks(self, cli, meta: dict, alt_clis=()) -> list:
         """Download one snapshot's chunks with per-chunk resource bounds
         (ADVICE r5): every chunk is size-capped BEFORE its hash check —
         the writer never produces a chunk above MAX_WIRE_CHUNK_BYTES, so
-        an oversized payload is hostile and raises SnapshotLimitError —
-        and corrupt chunks abort on first sight, not after the whole
-        download."""
+        an oversized payload is hostile and raises SnapshotLimitError
+        immediately (never retried).
+
+        A TRANSFER-corrupt or missing chunk, by contrast, is transient:
+        the chunk is marked bad and re-fetched — from a DIFFERENT peer
+        first when alternates exist — under the unified RetryPolicy, and
+        the download aborts only once a chunk exhausts its deadline
+        budget (``chunk_retry_deadline_s``)."""
         from celestia_tpu.node.snapshots import (
             MAX_WIRE_CHUNK_BYTES,
             SnapshotLimitError,
         )
 
         n_chunks = int(meta["chunks"])
+        sources = [cli, *alt_clis]
         chunks = []
         for i in range(n_chunks):
-            c = cli.snapshot_chunk(
-                int(meta["height"]), int(meta.get("format", 1)), i
-            )
-            if c is None:
-                raise ValueError(f"peer missing chunk {i}")
-            if len(c) > MAX_WIRE_CHUNK_BYTES:
-                raise SnapshotLimitError(
-                    f"chunk {i} is {len(c)} bytes "
-                    f"(cap {MAX_WIRE_CHUNK_BYTES})"
+            turn = [0]
+
+            def fetch_once(i=i, turn=turn):
+                # rotate sources: attempt 0 is the primary, each retry
+                # moves to the next peer (wrapping), so a peer serving
+                # bit-flipped bytes cannot fail the restore on its own
+                src = sources[turn[0] % len(sources)]
+                turn[0] += 1
+                faults.fire("snapshots.chunk")
+                c = src.snapshot_chunk(
+                    int(meta["height"]), int(meta.get("format", 1)), i
                 )
-            if hashlib.sha256(c).hexdigest() != meta["chunk_hashes"][i]:
-                raise ValueError(f"chunk {i} corrupt in transfer")
-            chunks.append(c)
+                if c is None:
+                    raise ValueError(f"peer missing chunk {i}")
+                if len(c) > MAX_WIRE_CHUNK_BYTES:
+                    raise SnapshotLimitError(
+                        f"chunk {i} is {len(c)} bytes "
+                        f"(cap {MAX_WIRE_CHUNK_BYTES})"
+                    )
+                c = faults.corrupt("snapshots.chunk", c)
+                if hashlib.sha256(c).hexdigest() != meta["chunk_hashes"][i]:
+                    raise ValueError(f"chunk {i} corrupt in transfer")
+                return c
+
+            policy = faults.RetryPolicy(
+                attempts=max(2, 2 * len(sources)),
+                base_s=0.02,
+                cap_s=0.5,
+                deadline_s=self.chunk_retry_deadline_s,
+            )
+            chunks.append(
+                policy.run(
+                    fetch_once,
+                    no_retry_on=(SnapshotLimitError,),
+                    on_retry=lambda n, e, i=i: self.log.warn(
+                        "snapshot chunk re-fetch", chunk=i, attempt=n,
+                        err=str(e)[:120],
+                    ),
+                )
+            )
         return chunks
 
     def _try_state_sync(self, cli, addr: str = "") -> bool:
@@ -751,8 +820,9 @@ class GossipEngine:
         )
 
         try:
-            metas = cli.snapshot_list()
-        except Exception:
+            metas = self._pull_rpc(cli.snapshot_list)
+        except Exception as e:
+            faults.note("gossip.fetch", e)
             return False
         metas = [
             m for m in metas if int(m.get("height", 0)) > self.node.height
@@ -787,7 +857,9 @@ class GossipEngine:
                     raise ValueError(
                         f"implausible snapshot shape: {n_chunks} chunks"
                     )
-                chunks = self._fetch_snapshot_chunks(cli, meta)
+                chunks = self._fetch_snapshot_chunks(
+                    cli, meta, self._alt_snapshot_clients(addr)
+                )
                 downloaded = True
                 data = SnapshotStore.assemble(meta, chunks)
                 self.node.adopt_state_sync(meta, data)
@@ -806,7 +878,7 @@ class GossipEngine:
                     err=str(e)[:200], peer=addr,
                 )
                 if addr:
-                    self._set_pull_backoff(addr, 60.0)
+                    self._breakers.trip(addr, 60.0)
                 return False
             except Exception as e:
                 self.log.warn("state-sync attempt failed", err=str(e)[:200])
@@ -816,7 +888,7 @@ class GossipEngine:
                     # hostile or corrupt — don't burn another full
                     # download on its next meta this attempt
                     if addr:
-                        self._set_pull_backoff(addr, 60.0)
+                        self._breakers.trip(addr, 60.0)
                     return False
                 continue
         return False

@@ -29,6 +29,8 @@ from typing import Optional
 
 import grpc
 
+from celestia_tpu.utils import faults
+
 SERVICE = "celestia.tpu.v1.Node"
 
 
@@ -39,8 +41,19 @@ def _identity(b: bytes) -> bytes:
 class NodeService:
     """Method implementations over an in-process node (TestNode surface)."""
 
-    def __init__(self, node):
+    def __init__(self, node, das_max_inflight: int = 4):
         self.node = node
+        # DAS serving-plane admission (specs/robustness.md): sampling
+        # requests above the inflight bound are SHED with a retry-after
+        # hint instead of queueing behind the service lock until every
+        # gRPC worker is wedged — the plane degrades, it never collapses.
+        # The bound must stay BELOW the gRPC worker count (NodeServer
+        # max_workers, default 8): with bound == workers no request can
+        # ever observe a full gate and shedding silently never happens,
+        # while consensus RPCs starve behind queued samples.
+        self.das_gate = faults.LoadShedGate(
+            max_inflight=das_max_inflight, retry_after_ms=25.0
+        )
 
     # -- handlers (bytes -> bytes) ------------------------------------
 
@@ -192,6 +205,39 @@ class NodeService:
         ok, why = self.node.bft_catchup(json.loads(req))
         return json.dumps({"ok": ok, "reason": why}).encode()
 
+    def das_sample(self, req: bytes, ctx) -> bytes:
+        """One DAS cell + proof to the data root, behind the load-shed
+        gate.  A shed response carries ``retry_after_ms`` so an honest
+        light client backs off through the unified RetryPolicy instead
+        of hammering a saturated node; the ``server.sample`` fault point
+        makes the handler itself injectable for the chaos suite (an
+        injected failure is reported as retriable, exactly like shed
+        load — the client cannot tell a chaos drill from real pressure)."""
+        if not self.das_gate.try_acquire():
+            return json.dumps(
+                {
+                    "shed": True,
+                    "retry_after_ms": self.das_gate.retry_after_ms,
+                }
+            ).encode()
+        try:
+            faults.fire("server.sample")
+            q = json.loads(req or b"{}")
+            out = self.node.abci_query("custom/das/sample", q)
+            return json.dumps({"shed": False, **out}, default=str).encode()
+        except faults.InjectedFault as e:
+            return json.dumps(
+                {
+                    "shed": True,
+                    "retry_after_ms": self.das_gate.retry_after_ms,
+                    "log": str(e),
+                }
+            ).encode()
+        except Exception as e:
+            return json.dumps({"code": 1, "log": str(e)}).encode()
+        finally:
+            self.das_gate.release()
+
     def query(self, req: bytes, ctx) -> bytes:
         q = json.loads(req or b"{}")
         path = q.get("path", "")
@@ -278,7 +324,8 @@ class NodeService:
                 try:
                     if self.node.broadcast_tx(raw).code == 0:
                         n += 1
-                except Exception:
+                except Exception as e:
+                    faults.note("server.txpush", e)
                     continue
         return json.dumps({"admitted": n}).encode()
 
@@ -293,6 +340,7 @@ class NodeService:
             "Status": self.status,
             "Block": self.block,
             "Query": self.query,
+            "DasSample": self.das_sample,
             "ConsPrepare": self.cons_prepare,
             "ConsProcess": self.cons_process,
             "ConsCommit": self.cons_commit,
@@ -328,9 +376,10 @@ class NodeServer:
         address: str = "127.0.0.1:0",
         block_interval_s: Optional[float] = None,
         max_workers: int = 8,
+        das_max_inflight: int = 4,
     ):
         self.node = node
-        self.service = NodeService(node)
+        self.service = NodeService(node, das_max_inflight=das_max_inflight)
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers)
         )

@@ -30,6 +30,7 @@ _lock = threading.Lock()
 _pool: Optional[ThreadPoolExecutor] = None
 _pool_size = 0
 _override: Optional[int] = None
+_respawns = 0  # pools rebuilt after an observed worker death
 
 
 def set_cpu_threads(n: Optional[int]) -> None:
@@ -78,14 +79,59 @@ def get_pool() -> ThreadPoolExecutor:
         return _pool
 
 
+def heal_pool() -> None:
+    """Drop the executor after an observed worker death so the next
+    :func:`get_pool` builds a fresh one.  The broken executor is not
+    shut down (other callers may hold it mid-map; its surviving workers
+    drain and idle) — the point is that NEW work lands on healthy
+    threads."""
+    global _pool, _pool_size, _respawns
+    with _lock:
+        _pool = None
+        _pool_size = 0
+        _respawns += 1
+
+
+def stats() -> dict:
+    with _lock:
+        return {"pool_size": _pool_size, "respawns": _respawns}
+
+
 def run_sharded(fn: Callable, items: Iterable) -> List:
     """Map ``fn`` over ``items`` on the shared pool, preserving order.
 
     Runs inline for a single worker or a single item (no pool overhead,
     and results stay deterministic either way — callers rely on the
     threaded path being byte-identical to the serial one).  The first
-    worker exception propagates."""
+    worker exception propagates to the submitter (celint R5: pooled work
+    never fails silently).
+
+    Worker-death recovery: a :class:`faults.WorkerDeath` (the
+    hostpool.worker fault point — the observable stand-in for a worker
+    thread dying) marks the pool for rebuild and the lost items re-run
+    inline, so a dead worker costs latency, never results."""
+    from celestia_tpu.utils import faults
+
     items = list(items)
     if cpu_threads() <= 1 or len(items) <= 1:
         return [fn(x) for x in items]
-    return list(get_pool().map(fn, items))
+
+    def _guarded(x):
+        faults.fire("hostpool.worker")
+        return fn(x)
+
+    futures = [get_pool().submit(_guarded, x) for x in items]
+    out: List = []
+    lost: List[int] = []
+    for i, fut in enumerate(futures):
+        try:
+            out.append(fut.result())
+        except faults.WorkerDeath as e:
+            faults.note("hostpool.worker", e)
+            out.append(None)
+            lost.append(i)
+    if lost:
+        heal_pool()  # queued work on the old pool still drains
+        for i in lost:
+            out[i] = fn(items[i])  # the item is never lost
+    return out

@@ -191,13 +191,30 @@ class LruCache:
         return new
 
     def put(self, key, value) -> bool:
-        """Insert or replace.  Returns True when ``key`` was new."""
+        """Insert or replace.  Returns True when ``key`` was new.
+
+        Under an armed ``lru.put`` fault the write is silently DROPPED
+        (a lost write, not an error): callers must already tolerate a
+        later miss by recomputing, and the chaos suite proves the
+        EDS/DAH cache and row memo do — an entry is either absent or
+        complete, never partial."""
+        from celestia_tpu.utils import faults
+
+        if faults.should_drop("lru.put"):
+            return False
         with self._lock:
             return self._insert_locked(key, value)
 
     def put_many(self, pairs: Iterable[Tuple[Any, Any]]) -> None:
         """Batch :meth:`put` under ONE lock acquisition — the batch is
-        atomic: no interleaved reader observes a half-inserted batch."""
+        atomic: no interleaved reader observes a half-inserted batch.
+        An armed ``lru.put`` fault drops the WHOLE batch (atomicity is
+        part of the contract; a half-landed batch would be exactly the
+        partial state the fault exists to rule out)."""
+        from celestia_tpu.utils import faults
+
+        if faults.should_drop("lru.put"):
+            return
         with self._lock:
             for key, value in pairs:
                 self._insert_locked(key, value)

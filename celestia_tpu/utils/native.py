@@ -35,6 +35,56 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 _has_glv = False
 
+# One-way degradation pin (specs/robustness.md "degradation ladder"): a
+# native fault mid-run poisons the library for the REST OF THE PROCESS,
+# so every caller falls back to the byte-identical table-GF/jax legs.
+# The pin is deliberately one-way — a library that faulted once under
+# load cannot be trusted to silently come back, and a mid-chain flap
+# between legs would make perf numbers and telemetry unreadable.  Only
+# clear_poison(force=True) (tests, operator intervention) clears it.
+_poison_lock = threading.Lock()
+_poison_reason: Optional[str] = None  # celint: guarded-by(_poison_lock)
+
+
+def poison(reason: str) -> None:
+    """Pin the native library OFF after a fault (loud, one-way)."""
+    global _poison_reason
+    from celestia_tpu.utils import faults
+    from celestia_tpu.utils.logging import Logger
+
+    with _poison_lock:
+        if _poison_reason is not None:
+            return  # already degraded; first reason wins
+        _poison_reason = reason
+    faults.record_degradation("native", reason)
+    Logger(level="warn").warn(
+        "native DA pipeline poisoned: falling back to the pure table-GF "
+        "path for the rest of the process (byte-identical, slower)",
+        reason=reason[:200],
+    )
+
+
+def poisoned() -> Optional[str]:
+    """The poison reason, or None when the native leg is trusted."""
+    with _poison_lock:
+        return _poison_reason
+
+
+def clear_poison(force: bool = False) -> None:
+    """Un-pin the degradation.  Refuses without ``force=True``: the pin
+    exists precisely so nothing switches back silently."""
+    global _poison_reason
+    with _poison_lock:
+        if _poison_reason is None:
+            return
+        if not force:
+            raise RuntimeError(
+                "the native pipeline was poisoned "
+                f"({_poison_reason!r}) and the degradation pin is one-way; "
+                "pass force=True only if you KNOW the fault is resolved"
+            )
+        _poison_reason = None
+
 
 def _build() -> bool:
     try:
@@ -140,6 +190,9 @@ def _load() -> Optional[ctypes.CDLL]:
 
 
 def available() -> bool:
+    with _poison_lock:
+        if _poison_reason is not None:
+            return False
     return _load() is not None
 
 
@@ -239,6 +292,9 @@ def extend_block_cpu(square: np.ndarray, nthreads: Optional[int] = None):
     CPU comparison leg for bench.py (role of Leopard-RS + crypto/sha256
     in the reference, SURVEY.md §2.2).
     """
+    from celestia_tpu.utils import faults
+
+    faults.fire("native.extend")
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
@@ -327,6 +383,9 @@ def extend_block_leopard_cpu(
     square -> (eds, axis roots, data root).  The honest vs_leopard_cpu
     comparison leg for bench.py (the reference's codec class at full
     size, same SHA/NMT stage as extend_block_cpu)."""
+    from celestia_tpu.utils import faults
+
+    faults.fire("native.extend")
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")

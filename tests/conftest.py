@@ -41,6 +41,26 @@ def pytest_configure(config):
 import pytest  # noqa: E402
 
 
+@pytest.fixture
+def chaos():
+    """The chaos harness handle: yields celestia_tpu.utils.faults with a
+    clean slate and GUARANTEES teardown — every armed fault point is
+    disarmed, stats are reset, and a native poison pin left by a
+    degradation test is force-cleared so later tests see the real
+    library.  Arm points with ``chaos.arm(...)`` (seeded; same seed =>
+    same schedule) and reproduce any chaos failure by re-arming with the
+    seed the failing test printed."""
+    from celestia_tpu.utils import faults, native
+
+    faults.disarm()
+    faults.reset_stats()
+    yield faults
+    faults.disarm()
+    faults.reset_stats()
+    if native.poisoned() is not None:
+        native.clear_poison(force=True)
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():
     """Drop compiled XLA executables at module boundaries.

@@ -402,3 +402,63 @@ def test_set_active_codec_refuses_switch_after_native_use(monkeypatch):
     finally:
         gf256._codec_used = prev_used
         gf256.set_active_codec(prev_codec, force=True)
+
+
+# ---------------------------------------------------------------------------
+# cache poisoning under injected lru.put faults (PR 7 chaos satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_lost_cache_writes_force_recompute_never_partial(chaos):
+    """With lru.put faults armed every insert is a LOST WRITE: the
+    EDS/DAH cache and row memo must simply miss and recompute — an entry
+    is either absent or complete, and the recomputed bytes match a
+    fault-free run exactly."""
+    app, key = _funded_app(b"chaos-lru")
+    txs = _send_txs(app, key)
+    prop_clean = app.prepare_proposal(txs)
+
+    eds_cache.clear()
+    dah_mod.clear_row_memo()
+    chaos.arm("lru.put", "fail_rate", rate=1.0, seed=13)
+    app2, key2 = _funded_app(b"chaos-lru")
+    txs2 = _send_txs(app2, key2)
+    prop = app2.prepare_proposal(txs2)
+    assert prop.data_root == prop_clean.data_root
+    # the prepare-leg insert was dropped: nothing resident
+    assert len(eds_cache.CACHE) == 0
+    # process re-validates from scratch (a MISS, not a poisoned hit) and
+    # still accepts — byte identity survives the lost writes
+    ok, reason = app2.process_proposal(
+        prop.block_txs, prop.square_size, prop.data_root
+    )
+    assert ok, reason
+    assert app2.telemetry.counters.get("eds_cache_miss_process") == 1
+    assert app2.telemetry.counters.get("eds_cache_hit_process") is None
+
+    # disarmed: the same flow caches and hits again (no lingering damage)
+    chaos.disarm()
+    eds_cache.clear()
+    app2._decoded_cache.clear()
+    prop = app2.prepare_proposal(txs2)
+    ok, _ = app2.process_proposal(
+        prop.block_txs, prop.square_size, prop.data_root
+    )
+    assert ok
+    assert app2.telemetry.counters.get("eds_cache_hit_process") == 1
+
+
+def test_dropped_batch_insert_is_all_or_nothing(chaos):
+    """put_many under an armed lru.put fault drops the WHOLE batch: a
+    half-landed row-memo batch would be exactly the partial state the
+    chaos suite exists to rule out."""
+    from celestia_tpu.utils.lru import LruCache
+
+    chaos.arm("lru.put", "fail_rate", rate=1.0, seed=3)
+    c = LruCache("chaos_batch", 16)
+    c.put_many([(i, i) for i in range(8)])
+    assert len(c) == 0
+    assert c.get_many(range(8)) == [None] * 8
+    chaos.disarm()
+    c.put_many([(i, i) for i in range(8)])
+    assert c.get_many(range(8)) == list(range(8))

@@ -1,0 +1,320 @@
+"""Unit suite for the unified robustness layer (utils/faults.py): seeded
+fault schedules, RetryPolicy backoff/deadline semantics, circuit
+breakers, load-shed gates, and the swallow-telemetry contract.
+
+Everything here is deterministic by construction — schedules and
+backoffs derive from explicit seeds through sha256 domain separation,
+never Python's per-process string hashing — so a failure reproduces from
+the seed in the assertion message.
+"""
+
+import pytest
+
+from celestia_tpu.utils import faults
+
+
+def _decisions(point, mode, n, **kw):
+    faults.arm(point, mode, **kw)
+    out = []
+    for _ in range(n):
+        try:
+            faults.fire(point)
+            out.append(False)
+        except faults.InjectedFault:
+            out.append(True)
+    faults.disarm(point)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# schedules
+# ---------------------------------------------------------------------------
+
+
+def test_same_seed_same_schedule(chaos):
+    a = _decisions("gossip.fetch", "fail_rate", 64, rate=0.3, seed=7)
+    b = _decisions("gossip.fetch", "fail_rate", 64, rate=0.3, seed=7)
+    assert a == b
+    assert any(a) and not all(a)  # a 30% schedule is neither empty nor total
+
+
+def test_distinct_seeds_distinct_schedules(chaos):
+    a = _decisions("gossip.fetch", "fail_rate", 64, rate=0.3, seed=7)
+    b = _decisions("gossip.fetch", "fail_rate", 64, rate=0.3, seed=8)
+    assert a != b
+
+
+def test_points_are_domain_separated(chaos):
+    """One global seed must not make every point fail in lockstep."""
+    a = _decisions("gossip.fetch", "fail_rate", 64, rate=0.5, seed=3)
+    b = _decisions("snapshots.chunk", "fail_rate", 64, rate=0.5, seed=3)
+    assert a != b
+
+
+def test_fail_once_fires_exactly_once(chaos):
+    got = _decisions("native.extend", "fail_once", 10)
+    assert got == [True] + [False] * 9
+
+
+def test_count_bounds_injections(chaos):
+    got = _decisions("gossip.fetch", "fail_rate", 50, rate=1.0, count=3, seed=1)
+    assert sum(got) == 3 and got[:3] == [True, True, True]
+
+
+def test_disarmed_point_is_a_noop(chaos):
+    faults.fire("native.extend")  # nothing armed: must not raise
+    assert not faults.should_drop("lru.put")
+    assert faults.corrupt("snapshots.chunk", b"abc") == b"abc"
+
+
+def test_corrupt_mode_flips_deterministically(chaos):
+    chaos.arm("snapshots.chunk", "corrupt", seed=11)
+    a = faults.corrupt("snapshots.chunk", b"\x00" * 64)
+    chaos.arm("snapshots.chunk", "corrupt", seed=11)
+    b = faults.corrupt("snapshots.chunk", b"\x00" * 64)
+    assert a == b != b"\x00" * 64
+    assert sum(x != 0 for x in a) == 1  # exactly one byte flipped
+    # fire() must NOT consume corrupt-mode schedule decisions
+    chaos.arm("snapshots.chunk", "corrupt", seed=11)
+    faults.fire("snapshots.chunk")
+    assert faults.corrupt("snapshots.chunk", b"\x00" * 64) == a
+
+
+def test_worker_death_flavor(chaos):
+    chaos.arm("hostpool.worker", "fail_once")
+    with pytest.raises(faults.WorkerDeath):
+        faults.fire("hostpool.worker")
+
+
+def test_env_spec_parsing(chaos):
+    faults.arm_from_spec(
+        "gossip.fetch:fail_rate,rate=0.25,seed=9;snapshots.chunk:corrupt,count=2"
+    )
+    armed = faults.armed_points()
+    assert armed["gossip.fetch"]["rate"] == 0.25
+    assert armed["gossip.fetch"]["seed"] == 9
+    assert armed["snapshots.chunk"]["mode"] == "corrupt"
+    assert armed["snapshots.chunk"]["count"] == 2
+
+
+def test_env_spec_rejects_junk(chaos):
+    with pytest.raises(ValueError):
+        faults.arm_from_spec("gossip.fetch")  # no mode
+    with pytest.raises(ValueError):
+        faults.arm_from_spec("no.such.point:fail_once")
+    with pytest.raises(ValueError):
+        faults.arm_from_spec("gossip.fetch:fail_rate,bogus=1")
+
+
+def test_note_records_swallows(chaos):
+    faults.note("gossip.pump", ValueError("boom"))
+    faults.note("gossip.pump", ValueError("boom2"))
+    notes = faults.fault_stats()["notes"]
+    assert notes["gossip.pump"]["count"] == 2
+    assert "boom2" in notes["gossip.pump"]["last"]
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def _virtual_time():
+    t = {"now": 0.0}
+
+    def clock():
+        return t["now"]
+
+    def sleep(s):
+        t["now"] += s
+
+    return t, clock, sleep
+
+
+def test_retry_succeeds_after_transients():
+    _, clock, sleep = _virtual_time()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    p = faults.RetryPolicy(
+        attempts=5, base_s=0.01, cap_s=0.1, seed=1, sleep=sleep, clock=clock
+    )
+    assert p.run(flaky) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_exhaustion_reraises_last_error():
+    _, clock, sleep = _virtual_time()
+    p = faults.RetryPolicy(
+        attempts=3, base_s=0.01, cap_s=0.1, seed=1, sleep=sleep, clock=clock
+    )
+    with pytest.raises(KeyError):
+        p.run(lambda: (_ for _ in ()).throw(KeyError("always")))
+
+
+def test_retry_deadline_budget_is_hard():
+    """A retry whose sleep would cross the deadline is never attempted."""
+    t, clock, sleep = _virtual_time()
+    p = faults.RetryPolicy(
+        attempts=1000, base_s=0.5, cap_s=0.5, deadline_s=2.0, seed=1,
+        sleep=sleep, clock=clock,
+    )
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        p.run(always)
+    assert t["now"] <= 2.0
+    assert calls["n"] <= 5  # 2.0s budget / 0.5s backoff + the first try
+
+
+def test_retry_backoff_is_seeded_and_capped():
+    a = list(
+        x
+        for x, _ in zip(
+            faults.RetryPolicy(base_s=0.05, cap_s=0.4, seed=5).backoffs(),
+            range(16),
+        )
+    )
+    b = list(
+        x
+        for x, _ in zip(
+            faults.RetryPolicy(base_s=0.05, cap_s=0.4, seed=5).backoffs(),
+            range(16),
+        )
+    )
+    assert a == b
+    assert all(0.05 <= x <= 0.4 for x in a)
+    assert len(set(a)) > 4  # decorrelated jitter, not a fixed ladder
+
+
+def test_no_retry_on_carves_out_hostile_errors():
+    class Hostile(ValueError):
+        pass
+
+    p = faults.RetryPolicy(attempts=5, base_s=0.001, sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def hostile():
+        calls["n"] += 1
+        raise Hostile("oversized")
+
+    with pytest.raises(Hostile):
+        p.run(hostile, retry_on=(ValueError,), no_retry_on=(Hostile,))
+    assert calls["n"] == 1  # no retry burned on a hostile failure
+
+
+def test_overloaded_retry_after_floors_the_sleep():
+    slept = []
+    p = faults.RetryPolicy(
+        attempts=2, base_s=0.001, cap_s=0.002, seed=1, sleep=slept.append
+    )
+    calls = {"n": 0}
+
+    def shed_once():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise faults.Overloaded("shed", retry_after_ms=50.0)
+        return "ok"
+
+    assert p.run(shed_once, retry_on=(faults.Overloaded,)) == "ok"
+    assert slept == [pytest.approx(0.05)]
+
+
+def test_poll_returns_value_and_respects_deadline():
+    t, clock, sleep = _virtual_time()
+    p = faults.RetryPolicy(
+        base_s=0.1, cap_s=0.2, deadline_s=5.0, seed=2, sleep=sleep, clock=clock
+    )
+    state = {"v": None}
+
+    def pred():
+        if t["now"] >= 1.0:
+            state["v"] = "ready"
+        return state["v"]
+
+    assert p.poll(pred, what="readiness") == "ready"
+
+    p2 = faults.RetryPolicy(
+        base_s=0.1, deadline_s=1.0, seed=2, sleep=sleep, clock=clock
+    )
+    with pytest.raises(TimeoutError, match="never"):
+        p2.poll(lambda: False, what="never")
+
+
+def test_poll_requires_deadline():
+    with pytest.raises(ValueError):
+        faults.RetryPolicy().poll(lambda: True)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker + registry
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_opens_half_opens_and_closes():
+    t, clock, _ = _virtual_time()
+    cb = faults.CircuitBreaker(failures_to_open=2, cooldown_s=10.0, clock=clock)
+    assert cb.state == "closed" and cb.allow()
+    cb.record_failure()
+    assert cb.allow()  # one failure is below the budget
+    cb.record_failure()
+    assert cb.state == "open" and not cb.allow()
+    t["now"] += 10.1
+    assert cb.state == "half-open"
+    assert cb.allow()  # the single probe
+    assert not cb.allow()  # no second concurrent probe
+    cb.record_ok()
+    assert cb.state == "closed" and cb.allow()
+
+
+def test_breaker_failed_probe_reopens():
+    t, clock, _ = _virtual_time()
+    cb = faults.CircuitBreaker(failures_to_open=1, cooldown_s=10.0, clock=clock)
+    cb.record_failure()
+    t["now"] += 10.1
+    assert cb.allow()
+    cb.record_failure()  # probe failed
+    assert not cb.allow() and cb.state == "open"
+
+
+def test_breaker_trip_uses_override_cooldown():
+    t, clock, _ = _virtual_time()
+    cb = faults.CircuitBreaker(failures_to_open=5, cooldown_s=1.0, clock=clock)
+    cb.trip(60.0)
+    assert cb.state == "open"
+    assert cb.cooldown_remaining() > 59.0
+
+
+def test_breaker_registry_isolates_keys():
+    reg = faults.BreakerRegistry(failures_to_open=1, cooldown_s=10.0)
+    reg.record_failure("bad:1")
+    assert not reg.available("bad:1")
+    assert reg.available("good:1") and reg.allow("good:1")
+    reg.drop("bad:1")
+    assert reg.available("bad:1")  # a dropped key starts fresh
+
+
+# ---------------------------------------------------------------------------
+# load shedding
+# ---------------------------------------------------------------------------
+
+
+def test_load_shed_gate_bounds_inflight():
+    g = faults.LoadShedGate(max_inflight=2, retry_after_ms=30.0)
+    assert g.try_acquire() and g.try_acquire()
+    assert not g.try_acquire()  # third concurrent request sheds
+    s = g.stats()
+    assert s == {
+        "max_inflight": 2, "inflight": 2, "admitted": 2, "shed": 1,
+    }
+    g.release()
+    assert g.try_acquire()  # capacity frees as requests complete

@@ -313,6 +313,107 @@ def test_r4_quiet_on_pool_sourced_counts():
 
 
 # ---------------------------------------------------------------------------
+# R5 sanctioned-retry
+# ---------------------------------------------------------------------------
+
+R5_BAD_SWALLOW = """
+    def pump(node):
+        try:
+            node.tick()
+        except Exception:
+            pass
+        try:
+            node.close()
+        except:
+            pass
+"""
+
+R5_BAD_SLEEP_LOOP = """
+    import time
+
+
+    def wait(node, h):
+        while node.height < h:
+            time.sleep(0.05)
+"""
+
+R5_BAD_SLEEP_ALIASES = """
+    import time as _time
+    from time import sleep
+
+
+    def wait(node, h):
+        for _ in range(10):
+            _time.sleep(0.1)
+        while True:
+            sleep(0.1)
+"""
+
+R5_GOOD = """
+    from celestia_tpu.utils import faults
+
+
+    def pump(node):
+        try:
+            node.tick()
+        except Exception as e:
+            faults.note("gossip.pump", e)
+        except ValueError:
+            pass
+
+
+    def wait(node, h):
+        faults.RetryPolicy(base_s=0.05, deadline_s=30.0).poll(
+            lambda: node.height >= h, what="height"
+        )
+
+
+    def once():
+        import time
+
+        time.sleep(0.1)  # not in a loop: plain pacing is fine
+"""
+
+R5_SUPPRESSED = """
+    import time
+
+
+    def pace():
+        while True:
+            # celint: allow(sanctioned-retry) — fixed-cadence pacing tick
+            time.sleep(1.0)
+"""
+
+
+def test_r5_fires_on_silent_swallows():
+    got = _ids(_lint(R5_BAD_SWALLOW))
+    assert got == ["sanctioned-retry", "sanctioned-retry"], got
+
+
+def test_r5_fires_on_sleep_retry_loops():
+    assert _ids(_lint(R5_BAD_SLEEP_LOOP)) == ["sanctioned-retry"]
+    got = _ids(_lint(R5_BAD_SLEEP_ALIASES))
+    assert got == ["sanctioned-retry", "sanctioned-retry"], got
+
+
+def test_r5_quiet_on_recorded_failures_and_policy_waits():
+    assert _ids(_lint(R5_GOOD)) == []
+
+
+def test_r5_suppression_with_reason_holds():
+    out = _lint(R5_SUPPRESSED)
+    assert _ids(out) == []
+    assert any(f.suppressed for f in out)
+
+
+def test_r5_sanctions_faults_module_itself():
+    assert (
+        _ids(_lint(R5_BAD_SLEEP_LOOP, relpath="celestia_tpu/utils/faults.py"))
+        == []
+    )
+
+
+# ---------------------------------------------------------------------------
 # directive hygiene
 # ---------------------------------------------------------------------------
 
@@ -353,7 +454,9 @@ def test_comment_line_allow_attaches_to_next_statement():
 
 
 def test_rule_aliases_resolve():
-    assert {ALIASES[a] for a in ("r1", "r2", "r3", "r4")} == set(REGISTRY)
+    assert {ALIASES[a] for a in ("r1", "r2", "r3", "r4", "r5")} == set(
+        REGISTRY
+    )
 
 
 def test_rules_subset_runs_only_named_rules():

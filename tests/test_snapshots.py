@@ -210,4 +210,7 @@ def test_state_sync_aborts_and_backs_off_on_oversized_chunk(monkeypatch):
             return b"\x00" * (snap_mod.MAX_WIRE_CHUNK_BYTES + 1)
 
     assert eng._try_state_sync(_EvilCli(), "evil:1") is False
-    assert eng._pull_backoff.get("evil:1", 0.0) > time.time() + 30
+    # the resource-bound violation trips the peer's circuit breaker for
+    # the long (60 s) cooldown, not the transient-failure 10 s
+    assert not eng._breakers.available("evil:1")
+    assert eng._breakers.cooldown_remaining("evil:1") > 30

@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test chaos native native-sanitize native-sanitize-tsan native-sanitize-asan bench
+.PHONY: lint test chaos trace-smoke native native-sanitize native-sanitize-tsan native-sanitize-asan bench
 
 ## celint: concurrency & determinism static analysis (exit 1 on findings)
 lint:
@@ -20,6 +20,12 @@ test:
 ## failure with CELESTIA_TPU_CHAOS_SEED / the seed in the test id.
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_chaos.py -q -m 'not slow' -p no:cacheprovider
+
+## observability boot gate: one tiny-k testnode block with tracing on;
+## asserts a non-empty, schema-valid Chrome trace (opens in Perfetto)
+## and a line-by-line-parseable Prometheus exposition
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/trace_smoke.py
 
 ## (re)build the production native library
 native:

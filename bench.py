@@ -599,6 +599,46 @@ def _row_memo_reuse(k: int):
     }
 
 
+def _trace_summary(k: int) -> dict:
+    """extras.trace_summary: per-phase ms of ONE cold prepare -> warm
+    process round at k, read mechanically from the block-lifecycle
+    tracer (utils/tracing.py) instead of hand-inserted clocks.  Each
+    block entry is the tracer's phase_breakdown: direct-child span
+    durations under the per-height root plus ``total_ms`` and
+    ``untraced_ms`` — the untraced remainder of the extend phase is the
+    pipeline-tail figure the ROADMAP previously described only in prose.
+    Tracing is enabled only for this leg and fully torn down after, so
+    every other bench number stays a tracer-off measurement."""
+    from celestia_tpu.utils import tracing
+
+    n_tx = max(2, k)
+    blob_bytes = max(478, (k * k * 478) // max(1, n_tx) - 4 * 478)
+    # a seed no other leg uses: the EDS cache is content-addressed, so
+    # fresh tx bytes guarantee the traced prepare extends COLD (real
+    # extension work in the phase split, then the warm EDS-cache hit on
+    # the process leg — both regimes in one trace) WITHOUT clearing the
+    # process-wide caches, whose accumulated counters the
+    # unified_caches extras snapshot still has to report
+    node, txs = _make_pfb_node_and_txs(n_tx, blob_bytes, 12, k, b"trace")
+    node.app.prepare_proposal(txs[:2])  # warm programs/caches off-trace
+    tracing.enable(4)
+    tracing.clear()
+    try:
+        prop = node.app.prepare_proposal(txs)
+        ok, reason = node.app.process_proposal(
+            prop.block_txs, prop.square_size, prop.data_root
+        )
+        assert ok, f"trace_summary round rejected its own block: {reason}"
+        out: dict = {"square": prop.square_size, "txs": len(txs)}
+        for tr in tracing.block_traces():
+            out[tr.name] = tracing.TRACER.phase_breakdown(tr)
+            out[tr.name]["spans"] = len(tr.spans)
+        return out
+    finally:
+        tracing.disable()
+        tracing.clear()
+
+
 def _unified_cache_stats() -> dict:
     """Process-wide view of every bounded cache (utils/lru.py registry):
     per-cache hit rate / evictions / approximate resident bytes plus the
@@ -816,6 +856,12 @@ def _host_only_main():
     except Exception as e:
         extras["fault_stats_error"] = repr(e)[:200]
     try:
+        # per-phase span breakdown of one prepare->process round (the
+        # observability plane's mechanical phase pin, BASELINE.md)
+        extras["trace_summary"] = _trace_summary(K)
+    except Exception as e:
+        extras["trace_summary_error"] = repr(e)[:200]
+    try:
         # LAST: snapshot after every leg has exercised its caches
         extras["unified_caches"] = _unified_cache_stats()
     except Exception as e:
@@ -967,6 +1013,12 @@ def main():
         extras["fault_stats"] = _fault_stats_extras()
     except Exception as e:
         extras["fault_stats_error"] = repr(e)[:200]
+    try:
+        # per-phase span breakdown of one prepare->process round (the
+        # observability plane's mechanical phase pin, BASELINE.md)
+        extras["trace_summary"] = _trace_summary(k)
+    except Exception as e:
+        extras["trace_summary_error"] = repr(e)[:200]
     try:
         # LAST: snapshot after every leg has exercised its caches
         extras["unified_caches"] = _unified_cache_stats()

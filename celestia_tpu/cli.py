@@ -151,6 +151,19 @@ def cmd_start(args) -> int:
     cfg = load_config(home, overrides=overrides)
     log = Logger(level=cfg.log.level, fmt=cfg.log.format, to_file=cfg.log.to_file)
 
+    trace_blocks = getattr(args, "trace_blocks", None)
+    if getattr(args, "trace", False) or trace_blocks is not None:
+        # block-lifecycle span tracing (utils/tracing.py): ring-buffered
+        # last-N-blocks, served over the TraceDump RPC; near-zero
+        # overhead would still argue for off-by-default — this is the
+        # operator's explicit opt-in (CELESTIA_TPU_TRACE works too).
+        # --trace-blocks alone implies --trace: sizing a ring you did
+        # not turn on would otherwise be a silent no-op.
+        from celestia_tpu.utils import tracing
+
+        tracing.enable(trace_blocks)
+        log.info("block tracing enabled", blocks=tracing.TRACER.max_blocks)
+
     genesis_path = Path(home) / "config" / "genesis.json"
     if not genesis_path.exists():
         raise SystemExit(f"no genesis at {genesis_path}; run `init` first")
@@ -517,6 +530,22 @@ def cmd_query(args) -> int:
         )))
     elif args.query_cmd == "invariants":
         print(json.dumps(node.abci_query("custom/crisis/invariants", {})))
+    elif args.query_cmd == "metrics":
+        # raw Prometheus text — pipe it to a file or a scraper probe
+        sys.stdout.write(node.metrics())
+    elif args.query_cmd == "trace-dump":
+        out = node.trace_dump(last=args.last or None)
+        if args.out:
+            # write ONLY the Chrome trace document: the file opens in
+            # Perfetto / chrome://tracing without editing
+            Path(args.out).write_text(json.dumps(out.get("trace", {})))
+            print(json.dumps({
+                "enabled": out.get("enabled", False),
+                "blocks": out.get("blocks", []),
+                "written": args.out,
+            }))
+        else:
+            print(json.dumps(out))
     elif args.query_cmd == "namespace-shares":
         # fetch + VERIFY all shares of a namespace like a rollup would
         from celestia_tpu.da import namespace_data as nsd_mod
@@ -1154,6 +1183,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="square sizes whose device programs compile at boot instead "
              "of stalling the first live block ('' disables)",
     )
+    sp.add_argument(
+        "--trace", action="store_true",
+        help="enable block-lifecycle span tracing (ring-buffered last-N "
+             "blocks, served by the TraceDump RPC as Perfetto-compatible "
+             "Chrome trace JSON; CELESTIA_TPU_TRACE=1 is equivalent)",
+    )
+    sp.add_argument(
+        "--trace-blocks", type=int, default=None, metavar="N",
+        help="how many recent block traces the ring keeps (default 8; "
+             "CELESTIA_TPU_TRACE_BLOCKS is equivalent)",
+    )
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser(
@@ -1267,6 +1307,15 @@ def build_parser() -> argparse.ArgumentParser:
     q = qs.add_parser("signing-info")
     q.add_argument("validator")
     qs.add_parser("invariants")
+    qs.add_parser("metrics", help="node Prometheus text exposition")
+    q = qs.add_parser(
+        "trace-dump",
+        help="last N block traces as Chrome trace JSON (open in Perfetto)",
+    )
+    q.add_argument("--last", type=int, default=0,
+                   help="only the most recent N block traces (0 = all kept)")
+    q.add_argument("--out", default=None,
+                   help="write the Chrome trace document to this file")
     q = qs.add_parser("das-sample", help="light-client availability sampling")
     q.add_argument("height", type=int)
     q.add_argument("--samples", type=int, default=16)

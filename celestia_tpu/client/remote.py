@@ -121,6 +121,22 @@ class RemoteNode:
             raise RemoteError(out.get("log", "query failed"))
         return out["value"]
 
+    # -- observability plane --------------------------------------------
+
+    def metrics(self) -> str:
+        """The node's Prometheus text exposition (the ``Metrics`` RPC):
+        counters, gauges, bounded histograms, cache registry."""
+        return self._call("Metrics", b"{}").decode()
+
+    def trace_dump(self, last: Optional[int] = None) -> dict:
+        """The node's last N block traces: ``{"enabled", "blocks",
+        "trace"}``; ``trace`` is Chrome trace-event JSON — write it to a
+        file and open it in Perfetto (ui.perfetto.dev) unchanged."""
+        payload: dict = {}
+        if last is not None:
+            payload["last"] = int(last)
+        return self._call_json("TraceDump", payload)
+
     # -- consensus surface (used by node/coordinator.py) ----------------
 
     def cons_prepare(self) -> dict:

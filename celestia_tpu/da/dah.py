@@ -31,6 +31,7 @@ from celestia_tpu.ops import nmt as nmt_ops
 from celestia_tpu.ops import rs
 from celestia_tpu.ops.gf256 import active_codec as _active_codec
 from celestia_tpu.ops.gf256 import encode_matrix_bits
+from celestia_tpu.utils import tracing
 from celestia_tpu.utils.lru import LruCache
 
 NMT_ROOT_SIZE = nmt_ops.NMT_DIGEST_SIZE  # 90
@@ -210,18 +211,39 @@ def _extend_and_header_host(
     extend->roots overlap (byte-identical to the device program — pinned
     by tests/test_leopard_codec.py / test_golden_vectors.py)."""
     from celestia_tpu.ops import gf256
-    from celestia_tpu.utils import native
+    from celestia_tpu.utils import hostpool, native
 
-    if gf256.active_codec() == gf256.CODEC_LEOPARD:
-        eds, roots, data_root = native.extend_block_leopard_cpu(square)
-    else:
-        eds, roots, data_root = native.extend_block_cpu(square)
-    n2 = 2 * square.shape[0]
-    dah = DataAvailabilityHeader(
-        tuple(roots[i].tobytes() for i in range(n2)),
-        tuple(roots[n2 + i].tobytes() for i in range(n2)),
-        data_root.tobytes(),
+    codec = gf256.active_codec()
+    # the fused C++ call computes extension AND all 4k roots in its
+    # 3-phase overlapped pipeline (row extend -> columns interleaved
+    # with top-row roots -> remaining roots); the span args record the
+    # fusion so the trace reader knows the roots phase below is the
+    # Python-side DAH assembly, not the hashing itself.  Args (incl. the
+    # cpu_threads() lock+env read) are built only when the tracer is on
+    # — this is the per-block host hot path.
+    span = (
+        tracing.span(
+            "extend.native",
+            codec=codec,
+            fused_roots=True,
+            nthreads=hostpool.cpu_threads(),
+            phases=3,
+        )
+        if tracing.enabled()
+        else tracing.NULL_SPAN
     )
+    with span:
+        if codec == gf256.CODEC_LEOPARD:
+            eds, roots, data_root = native.extend_block_leopard_cpu(square)
+        else:
+            eds, roots, data_root = native.extend_block_cpu(square)
+    n2 = 2 * square.shape[0]
+    with tracing.span("roots", stage="assemble", fused_native=True):
+        dah = DataAvailabilityHeader(
+            tuple(roots[i].tobytes() for i in range(n2)),
+            tuple(roots[n2 + i].tobytes() for i in range(n2)),
+            data_root.tobytes(),
+        )
     return ExtendedDataSquare(eds), dah
 
 
@@ -410,23 +432,26 @@ def _try_memoized_extend(
     if k - len(missing) < max(1, k // 4):
         return None
     n2 = 2 * k
-    top = np.empty((k, n2, B), dtype=np.uint8)
-    top[:, :k] = square
-    parity_by_digest: "Dict[bytes, np.ndarray]" = {}
-    if missing:
-        reps = list(missing.values())
-        data = square[reps]  # (m, k, B)
-        P = _gf_encode_axis(data.transpose(1, 0, 2).reshape(k, -1))
-        par = P.reshape(k, len(reps), B).transpose(1, 0, 2)  # (m, k, B)
-        for i, d in enumerate(missing):
-            parity_by_digest[d] = par[i]
-    for r, (d, e) in enumerate(zip(digests, entries)):
-        if e is not None:
-            top[r, k:] = np.frombuffer(e[0], dtype=np.uint8).reshape(k, B)
-        else:
-            top[r, k:] = parity_by_digest[d]
-    bottom = _gf_encode_axis(top.reshape(k, -1)).reshape(k, n2, B)
-    eds = np.concatenate([top, bottom], axis=0)
+    with tracing.span(
+        "extend.memo", k=k, memo_hits=k - len(missing), memo_misses=len(missing)
+    ):
+        top = np.empty((k, n2, B), dtype=np.uint8)
+        top[:, :k] = square
+        parity_by_digest: "Dict[bytes, np.ndarray]" = {}
+        if missing:
+            reps = list(missing.values())
+            data = square[reps]  # (m, k, B)
+            P = _gf_encode_axis(data.transpose(1, 0, 2).reshape(k, -1))
+            par = P.reshape(k, len(reps), B).transpose(1, 0, 2)  # (m, k, B)
+            for i, d in enumerate(missing):
+                parity_by_digest[d] = par[i]
+        for r, (d, e) in enumerate(zip(digests, entries)):
+            if e is not None:
+                top[r, k:] = np.frombuffer(e[0], dtype=np.uint8).reshape(k, B)
+            else:
+                top[r, k:] = parity_by_digest[d]
+        bottom = _gf_encode_axis(top.reshape(k, -1)).reshape(k, n2, B)
+        eds = np.concatenate([top, bottom], axis=0)
     from celestia_tpu.utils import native
 
     if native.available():
@@ -434,7 +459,8 @@ def _try_memoized_extend(
         # Python-orchestrated reduction even with most row roots memoized
         # (measured: selective batch over 3k+ trees is ~2.5x slower than
         # the full native pass) — reuse the extension, recompute roots
-        all_roots = native.eds_nmt_roots(eds)
+        with tracing.span("roots", stage="native_full_pass", trees=4 * k):
+            all_roots = native.eds_nmt_roots(eds)
         row_roots = [all_roots[i].tobytes() for i in range(n2)]
         col_roots = [all_roots[n2 + i].tobytes() for i in range(n2)]
         root_by_digest = {d: row_roots[r] for d, r in missing.items()}
@@ -451,7 +477,8 @@ def _try_memoized_extend(
         col_leaves = row_leaves.transpose(1, 0, 2)
         sel = list(missing.values()) + list(range(k, n2))
         trees = np.concatenate([row_leaves[sel], col_leaves], axis=0)
-        roots = nmt_ops.nmt_roots_host_batch(trees)
+        with tracing.span("roots", stage="host_batch", trees=len(trees)):
+            roots = nmt_ops.nmt_roots_host_batch(trees)
         m = len(missing)
         root_by_digest = {d: roots[i].tobytes() for i, d in enumerate(missing)}
         row_roots = []
@@ -510,7 +537,8 @@ def extend_and_header(
     k = square.shape[0]
     digests: Optional[List[bytes]] = None
     if host_regime() and _row_memo_applicable():
-        digests = _row_digests(square)
+        with tracing.span("row_digests", k=k):
+            digests = _row_digests(square)
         memoized = _try_memoized_extend(square, digests)
         if memoized is not None:
             return memoized
@@ -530,17 +558,21 @@ def extend_and_header(
             if digests is not None:
                 _memo_populate(k, digests, eds.shares, dah.row_roots)
             return eds, dah
-    eds_d, row_roots, col_roots, data_root = _extend_and_roots_fn(k, _active_codec())(
-        jnp.asarray(square)
-    )
+    with tracing.span("extend.jax", codec=_active_codec(), k=k, fused_roots=True):
+        eds_d, row_roots, col_roots, data_root = _extend_and_roots_fn(
+            k, _active_codec()
+        )(jnp.asarray(square))
     eds = ExtendedDataSquare(eds_d)  # stays on device until shares are read
-    rr = np.asarray(row_roots)
-    cc = np.asarray(col_roots)
-    dah = DataAvailabilityHeader(
-        tuple(rr[i].tobytes() for i in range(rr.shape[0])),
-        tuple(cc[i].tobytes() for i in range(cc.shape[0])),
-        np.asarray(data_root).tobytes(),
-    )
+    with tracing.span("roots", stage="fetch"):
+        # materializing the root arrays forces the (async) device values
+        # to host — on an attached chip this span IS the root fetch
+        rr = np.asarray(row_roots)
+        cc = np.asarray(col_roots)
+        dah = DataAvailabilityHeader(
+            tuple(rr[i].tobytes() for i in range(rr.shape[0])),
+            tuple(cc[i].tobytes() for i in range(cc.shape[0])),
+            np.asarray(data_root).tobytes(),
+        )
     if digests is not None:
         # host-regime jax fallback: the "device" array is CPU-backed, so
         # materializing the shares is a host copy, not a tunnel transfer
@@ -595,7 +627,8 @@ def new_data_availability_header(eds: ExtendedDataSquare) -> DataAvailabilityHea
     roots = None
     if _host_native_available():
         try:
-            roots = nmt_ops.eds_nmt_roots_host(eds.shares)
+            with tracing.span("roots", stage="host_pool", trees=2 * eds.width):
+                roots = nmt_ops.eds_nmt_roots_host(eds.shares)
         except Exception as e:
             # same one-way degradation as extend_and_header: poison the
             # native leg and recompute on the jax path (identical bytes)
@@ -603,7 +636,8 @@ def new_data_availability_header(eds: ExtendedDataSquare) -> DataAvailabilityHea
 
             _native.poison(f"eds_nmt_roots native leg failed: {e!r}")
     if roots is None:
-        roots = np.asarray(_eds_nmt_roots_jit(jnp.asarray(eds.shares)))
+        with tracing.span("roots", stage="jax"):
+            roots = np.asarray(_eds_nmt_roots_jit(jnp.asarray(eds.shares)))
     rows = tuple(roots[0, i].tobytes() for i in range(roots.shape[1]))
     cols = tuple(roots[1, i].tobytes() for i in range(roots.shape[1]))
     return DataAvailabilityHeader(

@@ -273,6 +273,18 @@ _TIME_FNS = {
     "perf_counter", "perf_counter_ns",
 }
 
+# The sanctioned wall-clock channels: the ONLY modules whose time.*
+# reads are part of the design (utils/telemetry clock() feeds durations;
+# utils/tracing builds spans on that same clock).  Consensus modules
+# reach clocks exclusively THROUGH these; the channels themselves are
+# scanned for the entropy bans (a tracer span id derived from random
+# bits would be exactly the nondeterminism R3 exists to stop), while
+# their wall-clock reads are, by definition, sanctioned.
+SANCTIONED_CHANNELS = (
+    "celestia_tpu/utils/telemetry.py",
+    "celestia_tpu/utils/tracing.py",
+)
+
 
 @register
 class ConsensusDeterminismRule(Rule):
@@ -283,12 +295,18 @@ class ConsensusDeterminismRule(Rule):
         "flags calls to time.time/time_ns/monotonic/perf_counter, any "
         "random.* / numpy .random.* / secrets.*, os.urandom, and "
         "iteration directly over a set (unordered -> nondeterministic "
-        "bytes).  Telemetry durations go through utils/telemetry clock(); "
-        "anything else needs an explicit allow with a reason."
+        "bytes).  Telemetry durations go through the sanctioned-channel "
+        "modules (utils/telemetry clock(), utils/tracing spans — "
+        "SANCTIONED_CHANNELS); anything else needs an explicit allow "
+        "with a reason.  The channel modules themselves are scanned for "
+        "the ENTROPY bans only: their clock reads are the channel, but "
+        "a random/urandom draw there (e.g. a random span id) would "
+        "launder nondeterminism through the one door left open."
     )
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        if not ctx.relpath.startswith(_CONSENSUS_PREFIXES):
+        in_channel = ctx.relpath in SANCTIONED_CHANNELS
+        if not in_channel and not ctx.relpath.startswith(_CONSENSUS_PREFIXES):
             return
         time_aliases: Set[str] = set()
         random_aliases: Set[str] = set()
@@ -323,6 +341,14 @@ class ConsensusDeterminismRule(Rule):
                         bare_banned[local] = f"secrets.{a.name}"
                     elif node.module == "numpy" and a.name == "random":
                         random_aliases.add(local)
+        if in_channel:
+            # the channel's wall-clock reads ARE the sanctioned channel;
+            # only the entropy bans apply inside it
+            time_aliases = set()
+            bare_banned = {
+                k: v for k, v in bare_banned.items()
+                if not v.startswith("time.")
+            }
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Call):
                 msg = self._call_verdict(
@@ -334,6 +360,8 @@ class ConsensusDeterminismRule(Rule):
                         self.id, ctx.relpath, node.lineno, node.col_offset,
                         msg,
                     )
+            elif in_channel:
+                continue  # set-iteration ban stays consensus-only
             elif isinstance(node, (ast.For, ast.comprehension)):
                 if _iterates_set(node.iter):
                     yield Finding(

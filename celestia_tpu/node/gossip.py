@@ -56,7 +56,7 @@ import time as _time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from celestia_tpu.utils import faults
+from celestia_tpu.utils import faults, tracing
 from celestia_tpu.utils.logging import Logger
 from celestia_tpu.utils.lru import LruCache, bytes_len_weigher
 
@@ -423,13 +423,24 @@ class GossipEngine:
                 # actual heights (a Byzantine validator can sign a vote
                 # at any height it likes)
                 self._behind_hint = h
-        try:
-            self.node.bft_msg(wire)
-        except Exception as e:
-            # engine rejects bad messages; a raise must not kill the RPC
-            # thread — but the failure lands in telemetry, never silently
-            faults.note("gossip.deliver", e)
-        self._flood(wire, exclude=sender)
+        # span args are built only when the tracer is on: this is the
+        # per-message flood hot path, and a NULL_SPAN must cost nothing
+        span = (
+            tracing.span(
+                "gossip.deliver", cat="gossip",
+                kind=str(wire.get("kind", "")), height=h,
+            )
+            if tracing.enabled()
+            else tracing.NULL_SPAN
+        )
+        with span:
+            try:
+                self.node.bft_msg(wire)
+            except Exception as e:
+                # engine rejects bad messages; a raise must not kill the RPC
+                # thread — but the failure lands in telemetry, never silently
+                faults.note("gossip.deliver", e)
+            self._flood(wire, exclude=sender)
         return True
 
     def stats(self) -> dict:
@@ -662,9 +673,14 @@ class GossipEngine:
     def _pull_rpc(self, fn, *args):
         """Every catch-up/state-sync pull RPC funnels through here: the
         ``gossip.fetch`` fault point lives at the top, so the chaos suite
-        can make any pull flaky without touching peer code."""
-        faults.fire("gossip.fetch")
-        return fn(*args)
+        can make any pull flaky without touching peer code — and the
+        ``gossip.fetch`` span makes every pull visible on the trace."""
+        with tracing.span(
+            "gossip.fetch", cat="gossip",
+            rpc=getattr(fn, "__name__", "rpc"),
+        ):
+            faults.fire("gossip.fetch")
+            return fn(*args)
 
     def _catch_up(self) -> None:
         """Pull decided blocks we're missing (background worker, direct
@@ -772,21 +788,26 @@ class GossipEngine:
                 # bit-flipped bytes cannot fail the restore on its own
                 src = sources[turn[0] % len(sources)]
                 turn[0] += 1
-                faults.fire("snapshots.chunk")
-                c = src.snapshot_chunk(
-                    int(meta["height"]), int(meta.get("format", 1)), i
-                )
-                if c is None:
-                    raise ValueError(f"peer missing chunk {i}")
-                if len(c) > MAX_WIRE_CHUNK_BYTES:
-                    raise SnapshotLimitError(
-                        f"chunk {i} is {len(c)} bytes "
-                        f"(cap {MAX_WIRE_CHUNK_BYTES})"
+                with tracing.span(
+                    "snapshot.chunk_fetch", cat="gossip",
+                    chunk=i, attempt=turn[0],
+                    height=int(meta["height"]),
+                ):
+                    faults.fire("snapshots.chunk")
+                    c = src.snapshot_chunk(
+                        int(meta["height"]), int(meta.get("format", 1)), i
                     )
-                c = faults.corrupt("snapshots.chunk", c)
-                if hashlib.sha256(c).hexdigest() != meta["chunk_hashes"][i]:
-                    raise ValueError(f"chunk {i} corrupt in transfer")
-                return c
+                    if c is None:
+                        raise ValueError(f"peer missing chunk {i}")
+                    if len(c) > MAX_WIRE_CHUNK_BYTES:
+                        raise SnapshotLimitError(
+                            f"chunk {i} is {len(c)} bytes "
+                            f"(cap {MAX_WIRE_CHUNK_BYTES})"
+                        )
+                    c = faults.corrupt("snapshots.chunk", c)
+                    if hashlib.sha256(c).hexdigest() != meta["chunk_hashes"][i]:
+                        raise ValueError(f"chunk {i} corrupt in transfer")
+                    return c
 
             policy = faults.RetryPolicy(
                 attempts=max(2, 2 * len(sources)),

@@ -17,6 +17,12 @@ Methods (service ``celestia.tpu.v1.Node``):
   Query        {"path": str, "data": {}}  -> ABCI-style query routes,
                including the proof routes (custom/proof/share,
                custom/proof/tx — pkg/proof/querier.go parity).
+  Metrics      {}                         -> Prometheus text exposition
+               (counters, gauges, bounded histograms, cache registry —
+               comet's DefaultMetricsProvider role)
+  TraceDump    {"last": N}                -> the last N block traces as
+               Chrome trace-event JSON (utils/tracing.py; open the
+               ``trace`` value directly in Perfetto)
 """
 
 from __future__ import annotations
@@ -29,7 +35,7 @@ from typing import Optional
 
 import grpc
 
-from celestia_tpu.utils import faults
+from celestia_tpu.utils import faults, tracing
 
 SERVICE = "celestia.tpu.v1.Node"
 
@@ -214,6 +220,7 @@ class NodeService:
         injected failure is reported as retriable, exactly like shed
         load — the client cannot tell a chaos drill from real pressure)."""
         if not self.das_gate.try_acquire():
+            tracing.instant("das_sample.shed", cat="serving")
             return json.dumps(
                 {
                     "shed": True,
@@ -221,9 +228,15 @@ class NodeService:
                 }
             ).encode()
         try:
-            faults.fire("server.sample")
             q = json.loads(req or b"{}")
-            out = self.node.abci_query("custom/das/sample", q)
+            with tracing.span(
+                "das_sample", cat="serving",
+                height=int(q.get("height", 0) or 0),
+                row=int(q.get("row", 0) or 0),
+                col=int(q.get("col", 0) or 0),
+            ):
+                faults.fire("server.sample")
+                out = self.node.abci_query("custom/das/sample", q)
             return json.dumps({"shed": False, **out}, default=str).encode()
         except faults.InjectedFault as e:
             return json.dumps(
@@ -237,6 +250,30 @@ class NodeService:
             return json.dumps({"code": 1, "log": str(e)}).encode()
         finally:
             self.das_gate.release()
+
+    # -- observability plane (utils/telemetry.py + utils/tracing.py) ----
+
+    def metrics(self, req: bytes, ctx) -> bytes:
+        """Prometheus text exposition of the node's telemetry: counters,
+        gauges, the bounded log2 histograms, per-span aggregates (when
+        tracing is on) and the unified cache registry.  Raw text bytes —
+        point a scraper straight at the RPC."""
+        return self.node.app.telemetry.export_prometheus().encode()
+
+    def trace_dump(self, req: bytes, ctx) -> bytes:
+        """The last N block traces (plus the background ring) as a Chrome
+        trace-event document: ``{"enabled", "blocks", "trace"}`` where
+        ``trace`` opens as-is in Perfetto / chrome://tracing."""
+        q = json.loads(req or b"{}")
+        last = q.get("last")
+        dump = tracing.trace_dump(int(last) if last is not None else None)
+        return json.dumps(
+            {
+                "enabled": tracing.enabled(),
+                "blocks": dump.get("otherData", {}).get("blocks", []),
+                "trace": dump,
+            }
+        ).encode()
 
     def query(self, req: bytes, ctx) -> bytes:
         q = json.loads(req or b"{}")
@@ -340,6 +377,8 @@ class NodeService:
             "Status": self.status,
             "Block": self.block,
             "Query": self.query,
+            "Metrics": self.metrics,
+            "TraceDump": self.trace_dump,
             "DasSample": self.das_sample,
             "ConsPrepare": self.cons_prepare,
             "ConsProcess": self.cons_process,

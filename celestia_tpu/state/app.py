@@ -70,6 +70,7 @@ from celestia_tpu.state.tx import (
     Tx,
     unmarshal_tx,
 )
+from celestia_tpu.utils import tracing
 from celestia_tpu.utils.lru import LruCache, bytes_len_weigher
 from celestia_tpu.utils.telemetry import Telemetry
 
@@ -562,48 +563,65 @@ class App:
         key = eds_cache.make_key(
             block_txs, square.size, self.app_version, _gf256.active_codec()
         )
-        cached = eds_cache.get(key)
-        if cached is not None:
-            self.telemetry.incr(f"eds_cache_hit_{leg}")
-            return cached
-        self.telemetry.incr(f"eds_cache_miss_{leg}")
-        eds, dah = dah_mod.extend_block(square)
-        eds_cache.put(key, eds, dah)
-        return eds, dah
+        with tracing.span("extend", leg=leg, k=square.size) as sp:
+            cached = eds_cache.get(key)
+            if cached is not None:
+                self.telemetry.incr(f"eds_cache_hit_{leg}")
+                sp.annotate(eds_cache="hit")
+                tracing.instant("eds_cache.hit", cat="cache", leg=leg)
+                return cached
+            self.telemetry.incr(f"eds_cache_miss_{leg}")
+            sp.annotate(eds_cache="miss")
+            tracing.instant("eds_cache.miss", cat="cache", leg=leg)
+            eds, dah = dah_mod.extend_block(square)
+            eds_cache.put(key, eds, dah)
+            return eds, dah
 
     def prepare_proposal(self, txs: List[bytes]) -> PreparedProposal:
         t0 = self.telemetry.clock()
         try:
+            # per-height root span (utils/tracing.py): the whole prepare
+            # leg with its phases as children, ring-buffered for trace_dump
+            with tracing.block_span(
+                "prepare_proposal", height=self.next_height(), txs=len(txs)
+            ):
+                return self._prepare_proposal_traced(txs, t0)
+        finally:
+            self.telemetry.measure_since("prepare_proposal", t0)
+
+    def _prepare_proposal_traced(
+        self, txs: List[bytes], t0: float
+    ) -> PreparedProposal:
+        with tracing.span("filter_txs", txs=len(txs)):
             kept = self._filter_txs(txs)
-            t1 = self.telemetry.clock()
+        t1 = self.telemetry.clock()
+        with tracing.span("square_build", txs=len(kept)):
             square, block_txs, wrappers = build_square(
                 kept, self.max_effective_square_size()
             )
-            t2 = self.telemetry.clock()
-            eds, dah = self._extend_block_cached(block_txs, square, "prepare")
-            t3 = self.telemetry.clock()
-            # per-phase budget (SURVEY §7 hard part c): host tx filtering,
-            # host square assembly, device extension incl. transfer —
-            # telemetry + last_prepare_breakdown let the bench isolate
-            # the tunnel RTT from real host-side overhead
-            self.last_prepare_breakdown = {
-                "filter_ms": (t1 - t0) * 1000.0,
-                "build_ms": (t2 - t1) * 1000.0,
-                "extend_ms": (t3 - t2) * 1000.0,
-            }
-            for name, v in self.last_prepare_breakdown.items():
-                self.telemetry.observe(f"prepare_proposal.{name}", v)
-            return PreparedProposal(
-                block_txs=block_txs,
-                square_size=square.size,
-                data_root=dah.hash,
-                eds=eds,
-                dah=dah,
-                square=square,
-                wrappers=wrappers,
-            )
-        finally:
-            self.telemetry.measure_since("prepare_proposal", t0)
+        t2 = self.telemetry.clock()
+        eds, dah = self._extend_block_cached(block_txs, square, "prepare")
+        t3 = self.telemetry.clock()
+        # per-phase budget (SURVEY §7 hard part c): host tx filtering,
+        # host square assembly, device extension incl. transfer —
+        # telemetry + last_prepare_breakdown let the bench isolate
+        # the tunnel RTT from real host-side overhead
+        self.last_prepare_breakdown = {
+            "filter_ms": (t1 - t0) * 1000.0,
+            "build_ms": (t2 - t1) * 1000.0,
+            "extend_ms": (t3 - t2) * 1000.0,
+        }
+        for name, v in self.last_prepare_breakdown.items():
+            self.telemetry.observe(f"prepare_proposal.{name}", v)
+        return PreparedProposal(
+            block_txs=block_txs,
+            square_size=square.size,
+            data_root=dah.hash,
+            eds=eds,
+            dah=dah,
+            square=square,
+            wrappers=wrappers,
+        )
 
     # ------------------------------------------------------------------
     # ProcessProposal — process_proposal.go:24-157
@@ -616,10 +634,28 @@ class App:
         (process_proposal.go:26-34)."""
         t0 = self.telemetry.clock()
         try:
-            branch = self.store.branch()
-            accounts = AccountKeeper(branch.store("auth"))
-            bank = BankKeeper(branch.store("bank"))
-            params = ParamsKeeper(branch.store("params"))
+            with tracing.block_span(
+                "process_proposal",
+                height=self.next_height(),
+                txs=len(block_txs),
+            ):
+                return self._process_proposal_traced(
+                    block_txs, square_size, data_root
+                )
+        except Exception as e:
+            self.telemetry.incr("process_proposal_panic_reject")
+            return False, f"proposal rejected: {e}"
+        finally:
+            self.telemetry.measure_since("process_proposal", t0)
+
+    def _process_proposal_traced(
+        self, block_txs: List[bytes], square_size: int, data_root: bytes
+    ) -> Tuple[bool, str]:
+        branch = self.store.branch()
+        accounts = AccountKeeper(branch.store("auth"))
+        bank = BankKeeper(branch.store("bank"))
+        params = ParamsKeeper(branch.store("params"))
+        with tracing.span("decode_and_ante", txs=len(block_txs)):
             for raw, tx, raw_inner, sig_ok, err in self._decode_proposal_txs(
                 block_txs
             ):
@@ -639,31 +675,27 @@ class App:
                     time_ns=self.block_time_ns,
                 )
                 run_ante(ctx)
-            # strict reconstruction — NOT skippable on a cache hit: the
-            # square must be re-derivable from the tx bytes under the
-            # CURRENT size bound, and only that reconstruction makes the
-            # cached (txs -> EDS/DAH) mapping apply to this proposal
+        # strict reconstruction — NOT skippable on a cache hit: the
+        # square must be re-derivable from the tx bytes under the
+        # CURRENT size bound, and only that reconstruction makes the
+        # cached (txs -> EDS/DAH) mapping apply to this proposal
+        with tracing.span("square_build", txs=len(block_txs)):
             square, re_txs, _ = construct_square(
                 block_txs, self.max_effective_square_size()
             )
-            if square.size != square_size:
-                return False, (
-                    f"square size mismatch: computed {square.size}, "
-                    f"header says {square_size}"
-                )
-            _, dah = self._extend_block_cached(block_txs, square, "process")
-            if dah.hash != data_root:
-                self.telemetry.incr("process_proposal_rejected_data_root")
-                return False, (
-                    f"data root mismatch: computed {dah.hash.hex()}, "
-                    f"header says {data_root.hex()}"
-                )
-            return True, ""
-        except Exception as e:
-            self.telemetry.incr("process_proposal_panic_reject")
-            return False, f"proposal rejected: {e}"
-        finally:
-            self.telemetry.measure_since("process_proposal", t0)
+        if square.size != square_size:
+            return False, (
+                f"square size mismatch: computed {square.size}, "
+                f"header says {square_size}"
+            )
+        _, dah = self._extend_block_cached(block_txs, square, "process")
+        if dah.hash != data_root:
+            self.telemetry.incr("process_proposal_rejected_data_root")
+            return False, (
+                f"data root mismatch: computed {dah.hash.hex()}, "
+                f"header says {data_root.hex()}"
+            )
+        return True, ""
 
     # ------------------------------------------------------------------
     # Block execution (Begin/Deliver/End/Commit)

@@ -342,13 +342,22 @@ def note(point: str, exc: BaseException) -> None:
     survives.  The audit-sweep contract (celint R5): a worker may keep
     its loop alive, but the failure must land in telemetry under a named
     point — never vanish in ``except Exception: pass``."""
+    r = repr(exc)[:200]
     with _lock:
         entry = _notes.get(point)
         if entry is None:
-            _notes[point] = [1, repr(exc)[:200]]
+            _notes[point] = [1, r]
         else:
             entry[0] += 1
-            entry[1] = repr(exc)[:200]
+            entry[1] = r
+    # the swallow also lands on the active trace as an instant event so
+    # a trace reader sees WHERE in the block the failure was absorbed
+    # (guarded: with tracing off this must stay one enabled() check,
+    # and it runs outside the lock on purpose)
+    from celestia_tpu.utils import tracing
+
+    if tracing.enabled():
+        tracing.instant("fault.note", cat="fault", point=point, error=r[:120])
 
 
 def record_degradation(subsystem: str, reason: str) -> None:
@@ -357,6 +366,13 @@ def record_degradation(subsystem: str, reason: str) -> None:
     slow now."""
     with _lock:
         _degradations.append({"subsystem": subsystem, "reason": reason[:300]})
+    from celestia_tpu.utils import tracing
+
+    if tracing.enabled():
+        tracing.instant(
+            "degradation", cat="fault", subsystem=subsystem,
+            reason=reason[:160],
+        )
 
 
 def fault_stats() -> dict:

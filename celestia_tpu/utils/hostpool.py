@@ -109,18 +109,58 @@ def run_sharded(fn: Callable, items: Iterable) -> List:
     Worker-death recovery: a :class:`faults.WorkerDeath` (the
     hostpool.worker fault point — the observable stand-in for a worker
     thread dying) marks the pool for rebuild and the lost items re-run
-    inline, so a dead worker costs latency, never results."""
-    from celestia_tpu.utils import faults
+    inline, so a dead worker costs latency, never results.
+
+    Tracing: when the block-lifecycle tracer is enabled AND the caller
+    sits inside a span, every item gets a ``hostpool.queue_wait`` span
+    (submit -> pick-up: the time the item sat behind other work — the
+    visible form of a pipeline tail) and a ``hostpool.task`` run span,
+    both parented to the SUBMITTING thread's span (contextvars do not
+    cross pool threads; the parent is captured here explicitly)."""
+    from celestia_tpu.utils import faults, tracing
 
     items = list(items)
     if cpu_threads() <= 1 or len(items) <= 1:
         return [fn(x) for x in items]
 
-    def _guarded(x):
-        faults.fire("hostpool.worker")
-        return fn(x)
+    parent = tracing.current()  # None when disabled or outside any span
+    if parent is not None:
+        from celestia_tpu.utils.telemetry import clock as _clock
 
-    futures = [get_pool().submit(_guarded, x) for x in items]
+        # queue-wait spans live on the SUBMITTER's track: they start at
+        # submit time, and stamping the worker's tid would overlap that
+        # worker's own run spans from earlier items
+        submitter = threading.current_thread()
+        sub_tid, sub_name = submitter.ident or 0, submitter.name
+
+        def _submit(i, x):
+            t_submit = _clock()
+
+            def _traced():
+                tracing.record_span(
+                    "hostpool.queue_wait", t_submit, _clock(),
+                    parent=parent, cat="hostpool", index=i,
+                    tid=sub_tid, thread_name=sub_name,
+                    # waits overlap each other on the submitter's track
+                    # (shared submit instant, staggered pick-ups):
+                    # async b/e export is the format's overlap mechanism
+                    render_async=True,
+                )
+                with tracing.span(
+                    "hostpool.task", parent=parent, cat="hostpool", index=i
+                ):
+                    faults.fire("hostpool.worker")
+                    return fn(x)
+
+            return get_pool().submit(_traced)
+
+        futures = [_submit(i, x) for i, x in enumerate(items)]
+    else:
+        def _guarded(x):
+            faults.fire("hostpool.worker")
+            return fn(x)
+
+        futures = [get_pool().submit(_guarded, x) for x in items]
     out: List = []
     lost: List[int] = []
     for i, fut in enumerate(futures):

@@ -118,7 +118,10 @@ def test_telemetry_prometheus_export():
     text = t.export_prometheus()
     assert "celestia_tpu_blocks_total 2" in text
     assert "celestia_tpu_height 42" in text
-    assert 'quantile="0.5"' in text
+    # timings export as proper bounded histograms (PR 8), not quantile
+    # summaries: cumulative buckets + sum + count
+    assert "# TYPE celestia_tpu_prepare_seconds histogram" in text
+    assert 'celestia_tpu_prepare_seconds_bucket{le="+Inf"} 1' in text
     assert "celestia_tpu_prepare_seconds_count 1" in text
 
 

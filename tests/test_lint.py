@@ -272,6 +272,57 @@ def test_r3_allow_with_reason_suppresses():
     assert suppressed[0].suppress_reason == "seeded sampling RNG"
 
 
+# the sanctioned-channel extension (PR 8): the tracer/telemetry modules
+# are the ONE place wall-clock reads are the design; the entropy bans
+# still apply inside them (a random span id would launder nondeterminism
+# through the open door)
+
+R3_CHANNEL_CLOCK_OK = """
+    import time
+
+
+    def clock():
+        return time.time()
+
+
+    def stamp_span():
+        return time.perf_counter()
+"""
+
+R3_CHANNEL_ENTROPY_BAD = """
+    import random
+    import time
+
+
+    def clock():
+        return time.time()
+
+
+    def span_id():
+        return random.getrandbits(64)
+"""
+
+
+def test_r3_sanctioned_channels_may_read_clocks():
+    from celestia_tpu.lint.rules import SANCTIONED_CHANNELS
+
+    assert "celestia_tpu/utils/tracing.py" in SANCTIONED_CHANNELS
+    assert "celestia_tpu/utils/telemetry.py" in SANCTIONED_CHANNELS
+    for rel in SANCTIONED_CHANNELS:
+        assert _ids(_lint(R3_CHANNEL_CLOCK_OK, rel)) == [], rel
+
+
+def test_r3_sanctioned_channels_still_ban_entropy():
+    got = _ids(_lint(R3_CHANNEL_ENTROPY_BAD, "celestia_tpu/utils/tracing.py"))
+    # random.getrandbits flagged; the clock read sanctioned
+    assert got == ["consensus-determinism"], got
+
+
+def test_r3_channel_scan_does_not_leak_to_other_utils():
+    # a non-channel utils module keeps the old scope: not scanned at all
+    assert _ids(_lint(R3_CHANNEL_ENTROPY_BAD, "celestia_tpu/utils/x.py")) == []
+
+
 # ---------------------------------------------------------------------------
 # R4 hostpool-discipline
 # ---------------------------------------------------------------------------

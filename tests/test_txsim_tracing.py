@@ -90,41 +90,103 @@ def test_cli_txsim_command(tmp_path):
 
 
 def test_store_tracer_observes_writes():
+    """Store writes route through the ONE tracing surface
+    (utils/tracing.trace_store_writes): each write/delete is captured on
+    the bridge AND lands as an instant event on the active span trace."""
+    from celestia_tpu.utils import tracing
+
     ms = MultiStore(["bank", "auth"])
-    events = []
-    ms.set_tracer(lambda op, store, key, value: events.append((op, store, key)))
-    ms.store("bank").set(b"k1", b"v1")
-    ms.store("auth").delete(b"k2")
-    # branches created after installation trace through to the same sink
-    branch = ms.branch()
-    branch.store("bank").set(b"k3", b"v3")
-    assert events == [
-        ("write", "bank", b"k1"),
-        ("delete", "auth", b"k2"),
-        ("write", "bank", b"k3"),
+    tracing.disable()
+    tracing.clear()
+    tracing.enable(4)
+    try:
+        with tracing.block_span("deliver_block", height=1):
+            with tracing.trace_store_writes(ms) as tracer_bridge:
+                ms.store("bank").set(b"k1", b"v1")
+                ms.store("auth").delete(b"k2")
+                # branches created after installation trace to the same sink
+                branch = ms.branch()
+                branch.store("bank").set(b"k3", b"v3")
+        assert tracer_bridge.events == [
+            ("write", "bank", b"k1"),
+            ("delete", "auth", b"k2"),
+            ("write", "bank", b"k3"),
+        ]
+        # outside the bridge nothing is captured (tracer uninstalled)
+        ms.store("bank").set(b"k4", b"v4")
+        assert len(tracer_bridge.events) == 3
+        # the same writes are instant events on the block trace, so a
+        # trace reader sees state mutations inline with the phase spans
+        tr = tracing.block_traces()[0]
+        store_events = [
+            ev for ev in tr.instants if ev["name"] == "store.write"
+        ]
+        assert [
+            (ev["args"]["op"], ev["args"]["store"]) for ev in store_events
+        ] == [("write", "bank"), ("delete", "auth"), ("write", "bank")]
+    finally:
+        tracing.disable()
+        tracing.clear()
+
+
+def test_store_tracer_nesting_restores_previous():
+    """An inner bridge chains to and then RESTORES the outer one: the
+    outer observer keeps seeing writes during and after the inner
+    context (review fix: exit used to uninstall unconditionally)."""
+    from celestia_tpu.utils import tracing
+
+    ms = MultiStore(["bank"])
+    with tracing.trace_store_writes(ms) as outer:
+        with tracing.trace_store_writes(ms) as inner:
+            ms.store("bank").set(b"a", b"1")
+        ms.store("bank").set(b"b", b"2")  # outer must still observe
+    ms.store("bank").set(b"c", b"3")  # nobody observes
+    assert [(op, k) for op, _s, k in inner.events] == [("write", b"a")]
+    assert [(op, k) for op, _s, k in outer.events] == [
+        ("write", b"a"), ("write", b"b"),
     ]
-    ms.set_tracer(None)
-    ms.store("bank").set(b"k4", b"v4")
-    assert len(events) == 3
+
+
+def test_store_tracer_nesting_emits_one_instant_per_write():
+    """With tracing ON, a nested bridge chain still emits exactly ONE
+    store.write instant per mutation (review fix: the chained outer
+    bridge used to re-emit, double-counting writes on the trace)."""
+    from celestia_tpu.utils import tracing
+
+    ms = MultiStore(["bank"])
+    tracing.disable()
+    tracing.clear()
+    tracing.enable(2)
+    try:
+        with tracing.block_span("deliver_block", height=1):
+            with tracing.trace_store_writes(ms) as outer:
+                with tracing.trace_store_writes(ms) as inner:
+                    ms.store("bank").set(b"a", b"1")
+        assert len(inner.events) == 1 and len(outer.events) == 1
+        tr = tracing.block_traces()[0]
+        writes = [ev for ev in tr.instants if ev["name"] == "store.write"]
+        assert len(writes) == 1, writes
+    finally:
+        tracing.disable()
+        tracing.clear()
 
 
 def test_tracer_can_follow_a_block():
     """Trace every store write made by one block's execution — the
-    debugging workflow SetCommitMultiStoreTracer exists for."""
+    debugging workflow SetCommitMultiStoreTracer exists for, through the
+    unified tracer surface."""
+    from celestia_tpu.utils import tracing
+
     alice = PrivateKey.from_seed(b"trace-alice")
     node = TestNode(funded_accounts=[(alice, 10**12)])
     signer = Signer(node, alice)
-    writes = []
-    node.app.store.set_tracer(
-        lambda op, store, key, value: writes.append((op, store))
-    )
     from celestia_tpu.state.tx import MsgSend
 
-    res = signer.submit_tx(
-        [MsgSend(signer.address, b"\x11" * 20, 1000)]
-    )
-    node.app.store.set_tracer(None)
+    with tracing.trace_store_writes(node.app.store) as bridge:
+        res = signer.submit_tx(
+            [MsgSend(signer.address, b"\x11" * 20, 1000)]
+        )
     assert res.code == 0
-    stores_touched = {s for _, s in writes}
+    stores_touched = {s for _, s, _ in bridge.events}
     # fee deduction + transfer touch bank; sequence bump touches auth
     assert "bank" in stores_touched and "auth" in stores_touched

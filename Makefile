@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test chaos trace-smoke native native-sanitize native-sanitize-tsan native-sanitize-asan bench
+.PHONY: lint test chaos trace-smoke bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
 
 ## celint: concurrency & determinism static analysis (exit 1 on findings)
 lint:
@@ -23,9 +23,17 @@ chaos:
 
 ## observability boot gate: one tiny-k testnode block with tracing on;
 ## asserts a non-empty, schema-valid Chrome trace (opens in Perfetto)
-## and a line-by-line-parseable Prometheus exposition
+## and a line-by-line-parseable Prometheus exposition, then a 2-node
+## merged-trace leg (two validator processes, one block, merged
+## Perfetto timeline with a non-empty cross-node link)
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/trace_smoke.py
+
+## bench regression watchdog: compares every headline metric's latest
+## BENCH_r*.json value against best-so-far (25% tolerance); exits loud
+## on regression
+bench-check:
+	$(PY) tools/bench_check.py
 
 ## (re)build the production native library
 native:

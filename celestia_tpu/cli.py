@@ -546,6 +546,40 @@ def cmd_query(args) -> int:
             }))
         else:
             print(json.dumps(out))
+    elif args.query_cmd == "cluster-trace":
+        # fan trace_dump + clock probes out to every peer and fold the
+        # dumps into ONE Perfetto timeline: a node track per peer,
+        # offsets applied, cross-node parent links as flow arrows
+        from celestia_tpu.node import cluster as cluster_mod
+        from celestia_tpu.utils.tracing import validate_chrome_trace
+
+        clients = _cluster_clients(node, args)
+        try:
+            merged = cluster_mod.cluster_trace(
+                clients, last=args.last or None
+            )
+        finally:
+            _close_clients(clients, node)
+        problems = validate_chrome_trace(merged)
+        if problems:
+            raise SystemExit(f"cluster-trace: invalid merge: {problems[:5]}")
+        Path(args.out).write_text(json.dumps(merged))
+        print(json.dumps({
+            "written": args.out,
+            "nodes": [n["node_id"] for n in merged["otherData"]["nodes"]],
+            "events": len(merged["traceEvents"]),
+            "cross_node_flows": merged["otherData"]["cross_node_flows"],
+        }))
+    elif args.query_cmd == "cluster-health":
+        # coordinator-side aggregated health: per-peer height, breaker
+        # states, cache hit rates, degradation/shed counts, RPC traffic
+        from celestia_tpu.node import cluster as cluster_mod
+
+        clients = _cluster_clients(node, args)
+        try:
+            print(json.dumps(cluster_mod.cluster_health(clients), indent=1))
+        finally:
+            _close_clients(clients, node)
     elif args.query_cmd == "namespace-shares":
         # fetch + VERIFY all shares of a namespace like a rollup would
         from celestia_tpu.da import namespace_data as nsd_mod
@@ -643,6 +677,42 @@ def cmd_query(args) -> int:
             ],
         }))
     return 0
+
+
+def _cluster_clients(seed, args):
+    """Clients for a cluster-wide query: the explicit --nodes list, or
+    the seed --node plus every peer its PEX surface reports."""
+    from celestia_tpu.client.remote import RemoteNode
+    from celestia_tpu.node import cluster as cluster_mod
+
+    timeout = getattr(args, "timeout", 120.0)
+    nodes = getattr(args, "nodes", None)
+    if nodes:
+        addrs = [a.strip() for a in nodes.split(",") if a.strip()]
+    else:
+        addrs = [args.node] + cluster_mod.discover_peers(seed)
+    clients, seen = [], set()
+    for addr in addrs:
+        if addr in seen:
+            continue
+        seen.add(addr)
+        if addr == args.node:
+            clients.append(seed)
+            continue
+        try:
+            clients.append(RemoteNode(addr, timeout_s=timeout))
+        except Exception as e:
+            print(
+                json.dumps({"unreachable": addr, "error": str(e)[:120]}),
+                file=sys.stderr,
+            )
+    return clients
+
+
+def _close_clients(clients, keep) -> None:
+    for c in clients:
+        if c is not keep:
+            c.close()
 
 
 def cmd_status(args) -> int:
@@ -1316,6 +1386,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="only the most recent N block traces (0 = all kept)")
     q.add_argument("--out", default=None,
                    help="write the Chrome trace document to this file")
+    q = qs.add_parser(
+        "cluster-trace",
+        help="fan trace-dump out to every peer and merge into ONE "
+             "Perfetto timeline (node tracks, aligned clocks, "
+             "cross-node flow links)",
+    )
+    q.add_argument("--nodes", default=None,
+                   help="comma-separated peer gRPC addresses (default: "
+                        "--node plus its PEX-reported peers)")
+    q.add_argument("--last", type=int, default=0,
+                   help="only the most recent N block traces per node")
+    q.add_argument("--out", default="cluster.trace.json",
+                   help="write the merged Chrome trace document here")
+    q = qs.add_parser(
+        "cluster-health",
+        help="aggregated per-peer health: heights, breaker states, "
+             "cache hit rates, degradation/shed counts, RPC traffic",
+    )
+    q.add_argument("--nodes", default=None,
+                   help="comma-separated peer gRPC addresses (default: "
+                        "--node plus its PEX-reported peers)")
     q = qs.add_parser("das-sample", help="light-client availability sampling")
     q.add_argument("height", type=int)
     q.add_argument("--samples", type=int, default=16)

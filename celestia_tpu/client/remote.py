@@ -10,13 +10,37 @@ ConfirmTx poll :365-395).
 from __future__ import annotations
 
 import json
-from typing import Optional
+from typing import List, Optional
 
 import grpc
 
 from celestia_tpu.client.signer import SubmitResult
+from celestia_tpu.utils import tracing
+from celestia_tpu.utils.telemetry import Telemetry, snake_case
 
 SERVICE = "celestia.tpu.v1.Node"
+
+# Client-side RPC byte/count telemetry: one process-wide Telemetry for
+# every RemoteNode (gossip links, catch-up pulls, CLI tools) — counters
+# only, named rpc_client_{method}_{calls,bytes_in,bytes_out}.  The node
+# Metrics RPC appends these via client_rpc_exposition(), so a node's
+# OWN outbound traffic (state-sync, catch-up) is scrapeable next to its
+# serving-side counters.
+RPC_TELEMETRY = Telemetry()
+
+
+def client_rpc_exposition() -> List[str]:
+    """Prometheus lines for the client-side RPC counters.  Hand-built
+    from the counter map (never Telemetry.export_prometheus(): that
+    would re-emit the shared cache-registry/span sections a node's own
+    export already carries, and duplicate samples are malformed)."""
+    counters, _gauges, _timings = RPC_TELEMETRY._snapshot()
+    lines: List[str] = []
+    for name, val in sorted(counters.items()):
+        metric = f"celestia_tpu_{name}_total"
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {val}")
+    return lines
 
 
 class RemoteError(RuntimeError):
@@ -59,13 +83,32 @@ class RemoteNode:
                 response_deserializer=lambda b: b,
             )
             self._methods[method] = fn
+        prefix = f"rpc_client_{snake_case(method)}"
+        RPC_TELEMETRY.incr(f"{prefix}_calls")
+        RPC_TELEMETRY.incr(f"{prefix}_bytes_out", len(payload))
         try:
-            return fn(payload, timeout=self.timeout_s)
+            resp = fn(payload, timeout=self.timeout_s)
         except grpc.RpcError as e:
+            RPC_TELEMETRY.incr(f"{prefix}_errors")
             raise RemoteError(f"{method}: {e.code().name} {e.details()}") from e
+        RPC_TELEMETRY.incr(f"{prefix}_bytes_in", len(resp) if resp else 0)
+        return resp
 
     def _call_json(self, method: str, obj: dict) -> dict:
         return json.loads(self._call(method, json.dumps(obj).encode()))
+
+    @staticmethod
+    def _attach_tc(payload: dict, tc=None, height: int = 0) -> dict:
+        """Attach the optional cross-node trace context: an explicit
+        ``tc`` (a context being FORWARDED, e.g. the coordinator relaying
+        the proposer's prepare context) wins over the ambient one; with
+        tracing disabled and no explicit context the envelope is
+        byte-identical to the pre-context wire format."""
+        if tc is None:
+            tc = tracing.wire_context(height=height)
+        if tc:
+            payload["_tc"] = tc
+        return payload
 
     # -- TestNode-compatible client surface ----------------------------
 
@@ -137,46 +180,77 @@ class RemoteNode:
             payload["last"] = int(last)
         return self._call_json("TraceDump", payload)
 
+    def clock_probe(self) -> dict:
+        """One peer telemetry-clock read: ``{"ts", "node_id",
+        "height"}`` (the ClockProbe RPC)."""
+        return self._call_json("ClockProbe", {})
+
+    def clock_offset(self, samples: int = 5) -> dict:
+        """Midpoint-estimate this peer's clock offset/RTT
+        (``{"offset_s", "rtt_s", "samples"}``; see
+        tracing.estimate_clock_offset).  Raises RemoteError against an
+        un-upgraded peer without the ClockProbe RPC — callers treat
+        that as offset unknown (0)."""
+        return tracing.estimate_clock_offset(
+            lambda: self.clock_probe()["ts"], samples=samples
+        )
+
     # -- consensus surface (used by node/coordinator.py) ----------------
 
     def cons_prepare(self) -> dict:
-        out = self._call_json("ConsPrepare", {})
-        return {
+        out = self._call_json("ConsPrepare", self._attach_tc({}))
+        result = {
             "block_txs": [bytes.fromhex(t) for t in out["block_txs"]],
             "square_size": out["square_size"],
             "data_root": bytes.fromhex(out["data_root"]),
         }
+        # the proposer's prepare-root trace context, when its tracer is
+        # on: the coordinator forwards this into cons_process/commit so
+        # validator-side spans carry the PROPOSER as their cross-node
+        # parent (old servers simply never return it)
+        if out.get("_tc"):
+            result["_tc"] = out["_tc"]
+        return result
 
-    def cons_process(self, block_txs, square_size: int, data_root: bytes):
+    def cons_process(
+        self, block_txs, square_size: int, data_root: bytes, tc=None
+    ):
         out = self._call_json(
             "ConsProcess",
-            {
-                "block_txs": [t.hex() for t in block_txs],
-                "square_size": square_size,
-                "data_root": data_root.hex(),
-            },
+            self._attach_tc(
+                {
+                    "block_txs": [t.hex() for t in block_txs],
+                    "square_size": square_size,
+                    "data_root": data_root.hex(),
+                },
+                tc=tc,
+            ),
         )
         return out["accept"], out.get("reason", "")
 
     def cons_commit(
         self, block_txs, height: int, time_ns: int, data_root: bytes,
-        square_size: int, proposer: bytes = b"", votes=None,
+        square_size: int, proposer: bytes = b"", votes=None, tc=None,
     ) -> bytes:
         out = self._call_json(
             "ConsCommit",
-            {
-                "block_txs": [t.hex() for t in block_txs],
-                "height": height,
-                "time_ns": time_ns,
-                "data_root": data_root.hex(),
-                "square_size": square_size,
-                "proposer": proposer.hex(),
-                "votes": (
-                    [[a.hex(), bool(ok)] for a, ok in votes]
-                    if votes is not None
-                    else None
-                ),
-            },
+            self._attach_tc(
+                {
+                    "block_txs": [t.hex() for t in block_txs],
+                    "height": height,
+                    "time_ns": time_ns,
+                    "data_root": data_root.hex(),
+                    "square_size": square_size,
+                    "proposer": proposer.hex(),
+                    "votes": (
+                        [[a.hex(), bool(ok)] for a, ok in votes]
+                        if votes is not None
+                        else None
+                    ),
+                },
+                tc=tc,
+                height=height,
+            ),
         )
         return bytes.fromhex(out["app_hash"])
 
@@ -186,6 +260,18 @@ class RemoteNode:
         self._call_json("BftStart", {"height": height})
 
     def bft_msg(self, wire: dict) -> None:
+        # the relay forwards wires verbatim (no outer envelope), so the
+        # trace context rides INSIDE the wire dict under "_tc": old
+        # receivers hand it to an engine that ignores unknown keys, new
+        # receivers strip it before delivery.  Never mutate the caller's
+        # dict — the relay re-forwards the same object to other peers.
+        if tracing.enabled():
+            wire = dict(
+                wire,
+                _tc=tracing.wire_context(
+                    height=int(wire.get("height", 0) or 0)
+                ),
+            )
         self._call_json("BftMsg", wire)
 
     def bft_timeout(self, step: str, height: int, round_: int) -> None:
@@ -248,7 +334,11 @@ class RemoteNode:
 
         def attempt():
             out = self._call_json(
-                "DasSample", {"height": height, "row": row, "col": col}
+                "DasSample",
+                self._attach_tc(
+                    {"height": height, "row": row, "col": col},
+                    height=height,
+                ),
             )
             if out.get("shed"):
                 raise faults.Overloaded(
@@ -274,7 +364,10 @@ class RemoteNode:
 
     def snapshot_chunk(self, height: int, fmt: int, idx: int):
         out = self._call_json(
-            "SnapshotChunk", {"height": height, "format": fmt, "idx": idx}
+            "SnapshotChunk",
+            self._attach_tc(
+                {"height": height, "format": fmt, "idx": idx}, height=height
+            ),
         )
         if not out.get("found"):
             return None

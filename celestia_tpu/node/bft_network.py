@@ -48,6 +48,7 @@ from celestia_tpu.node.mempool import Mempool
 from celestia_tpu.node.network import ConsensusFailure
 from celestia_tpu.node.testnode import Block, BlockHeader
 from celestia_tpu.state.app import App
+from celestia_tpu.utils import tracing
 from celestia_tpu.utils.secp256k1 import PrivateKey
 
 
@@ -253,7 +254,19 @@ class BFTNetwork:
                     continue
                 if self._dropped(sender, val.name):
                     continue
-                val.engine.receive(wire)
+                if tracing.enabled():
+                    # the in-process analogue of the mesh's envelope
+                    # context: sender/receiver attribution on every
+                    # delivery, so harness runs read like mesh traces
+                    with tracing.span(
+                        "bftnet.deliver", cat="gossip",
+                        sender=sender, receiver=val.name,
+                        kind=str(wire.get("kind", "")),
+                        height=int(wire.get("height", 0) or 0),
+                    ):
+                        val.engine.receive(wire)
+                else:
+                    val.engine.receive(wire)
             self._drain_outboxes()
             delivered += 1
             if delivered > max_msgs:

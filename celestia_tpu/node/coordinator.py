@@ -37,8 +37,34 @@ import time as _time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
+import functools
+import inspect
+
 from celestia_tpu.node.network import ConsensusFailure, RoundResult, Vote
 from celestia_tpu.utils import faults
+
+
+@functools.lru_cache(maxsize=None)
+def _type_accepts_tc(cls: type, method: str) -> bool:
+    """Whether ``cls.<method>`` declares the optional trace-context
+    kwarg (RemoteNode does; the in-process TestNode surface does not —
+    hand it only to clients that declare it).  Cached by type: the
+    answer is constant per client class, and inspect.signature is too
+    reflective for the per-block consensus loop."""
+    fn = getattr(cls, method, None)
+    if fn is None:
+        return False
+    try:
+        return "tc" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def _accepts_tc(bound_method) -> bool:
+    owner = getattr(bound_method, "__self__", None)
+    if owner is None:
+        return False
+    return _type_accepts_tc(type(owner), bound_method.__name__)
 
 
 @dataclass
@@ -152,6 +178,17 @@ class ProcessCoordinator:
             )
             self.rounds.append(result)
             return result
+        # the proposer's prepare-root trace context (when its tracer is
+        # on): forwarded into every validator's process/commit RPC so
+        # their spans name the PROPOSER as cross-node parent — the
+        # coordinator is glue, not the causal origin.  Absent against
+        # un-upgraded or untraced proposers; clients that don't declare
+        # the kwarg (in-process TestNode surface) are never handed it.
+        tc = proposal.get("_tc")
+
+        def tc_kwargs(fn):
+            return {"tc": tc} if tc and _accepts_tc(fn) else {}
+
         votes: List[Vote] = []
         accept_power = 0
         for peer in self.peers:
@@ -166,6 +203,7 @@ class ProcessCoordinator:
                         proposal["block_txs"],
                         proposal["square_size"],
                         proposal["data_root"],
+                        **tc_kwargs(peer.client.cons_process),
                     )
                 except Exception as e:  # unreachable validator = NO vote
                     ok, reason = False, f"vote failed: {e}"
@@ -193,6 +231,7 @@ class ProcessCoordinator:
                         proposal["block_txs"], height, self._now_ns,
                         proposal["data_root"], proposal["square_size"],
                         proposer=proposer.address, votes=vote_pairs,
+                        **tc_kwargs(peer.client.cons_commit),
                     )
                     peer.height = height
                 except Exception:

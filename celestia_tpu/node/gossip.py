@@ -352,6 +352,16 @@ class GossipEngine:
 
     def _flood(self, wire: dict, exclude: Optional[str] = None) -> None:
         payload = {"wire": wire, "sender": self._self_name()}
+        if tracing.enabled():
+            # optional envelope trace context (NEVER inside `wire`: the
+            # dedup id is a content hash of the wire, and a per-hop
+            # context stamped into it would defeat flood dedup).  Old
+            # peers read wire/sender only and drop this silently.
+            tc = tracing.wire_context(
+                height=int(wire.get("height", 0) or 0)
+            )
+            if tc:
+                payload["_tc"] = tc
         peers = self._peers_snapshot(exclude)
         if len(peers) > self.fanout:
             # epidemic spread: each hop re-floods to its own sample, so
@@ -403,12 +413,14 @@ class GossipEngine:
         except Exception:
             return False
 
-    def on_gossip(self, wire: dict, sender: str) -> bool:
+    def on_gossip(self, wire: dict, sender: str, tc=None) -> bool:
         """Deliver a flooded consensus message once; queue the re-flood.
         The dedup id is computed HERE from the wire bytes — a sender-
         supplied id could poison the dedup set (censorship) — and only
         validator-signed messages propagate.  Returns True if the
-        message was new and valid."""
+        message was new and valid.  ``tc`` is the optional envelope
+        trace context of the SENDING hop (specs/observability.md): it
+        only decorates the deliver span, never consensus handling."""
         msg_id = wire_id(wire)
         if msg_id in self._seen:
             return False
@@ -426,8 +438,8 @@ class GossipEngine:
         # span args are built only when the tracer is on: this is the
         # per-message flood hot path, and a NULL_SPAN must cost nothing
         span = (
-            tracing.span(
-                "gossip.deliver", cat="gossip",
+            tracing.rpc_span(
+                "gossip.deliver", tc, cat="gossip",
                 kind=str(wire.get("kind", "")), height=h,
             )
             if tracing.enabled()

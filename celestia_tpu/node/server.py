@@ -19,10 +19,24 @@ Methods (service ``celestia.tpu.v1.Node``):
                custom/proof/tx — pkg/proof/querier.go parity).
   Metrics      {}                         -> Prometheus text exposition
                (counters, gauges, bounded histograms, cache registry —
-               comet's DefaultMetricsProvider role)
+               comet's DefaultMetricsProvider role — plus per-RPC
+               byte/call counters, client-side RPC counters and
+               fault/degradation totals)
   TraceDump    {"last": N}                -> the last N block traces as
                Chrome trace-event JSON (utils/tracing.py; open the
                ``trace`` value directly in Perfetto)
+  ClockProbe   {}                         -> {"ts", "node_id", "height"}:
+               one telemetry-clock read for the cross-node midpoint
+               offset probe (tracing.estimate_clock_offset)
+
+Cross-node trace context: consensus, gossip, state-sync and DAS
+requests may carry an optional ``"_tc"`` envelope field (specs/
+observability.md "Distributed tracing").  Handlers read named keys, so
+un-upgraded peers ignore the field and upgraded ones open an
+``rpc.*`` span whose ``remote_node``/``remote_span`` args name the
+caller's span — the explicit cross-node parent the trace merger folds
+into flow events.  Every handler also counts ``rpc_{method}_calls`` and
+``rpc_{method}_bytes_{in,out}`` into the node's telemetry.
 """
 
 from __future__ import annotations
@@ -147,40 +161,50 @@ class NodeService:
     # prepare -> process votes -> commit across the validator processes.
 
     def cons_prepare(self, req: bytes, ctx) -> bytes:
-        p = self.node.cons_prepare()
-        return json.dumps(
-            {
-                "block_txs": [t.hex() for t in p["block_txs"]],
-                "square_size": p["square_size"],
-                "data_root": p["data_root"].hex(),
-            }
-        ).encode()
+        q = json.loads(req or b"{}")
+        with tracing.rpc_span("rpc.cons_prepare", q.get("_tc")):
+            p = self.node.cons_prepare()
+        out = {
+            "block_txs": [t.hex() for t in p["block_txs"]],
+            "square_size": p["square_size"],
+            "data_root": p["data_root"].hex(),
+        }
+        # hand the caller the prepare root's trace context: the
+        # coordinator forwards it to every validator's cons_process so
+        # the cross-node parent is the PROPOSER's prepare span, not the
+        # coordinator's glue
+        tc = tracing.last_block_context("prepare_proposal")
+        if tc is not None:
+            out["_tc"] = tc
+        return json.dumps(out).encode()
 
     def cons_process(self, req: bytes, ctx) -> bytes:
         q = json.loads(req)
-        ok, reason = self.node.cons_process(
-            [bytes.fromhex(t) for t in q["block_txs"]],
-            int(q["square_size"]),
-            bytes.fromhex(q["data_root"]),
-        )
+        with tracing.rpc_span("rpc.cons_process", q.get("_tc")):
+            ok, reason = self.node.cons_process(
+                [bytes.fromhex(t) for t in q["block_txs"]],
+                int(q["square_size"]),
+                bytes.fromhex(q["data_root"]),
+            )
         return json.dumps({"accept": ok, "reason": reason}).encode()
 
     def cons_commit(self, req: bytes, ctx) -> bytes:
         q = json.loads(req)
         votes = q.get("votes")
-        app_hash = self.node.cons_commit(
-            [bytes.fromhex(t) for t in q["block_txs"]],
-            int(q["height"]),
-            int(q["time_ns"]),
-            bytes.fromhex(q["data_root"]),
-            int(q["square_size"]),
-            proposer=bytes.fromhex(q.get("proposer", "") or ""),
-            votes=(
-                [(bytes.fromhex(a), bool(ok)) for a, ok in votes]
-                if votes is not None
-                else None
-            ),
-        )
+        with tracing.rpc_span("rpc.cons_commit", q.get("_tc")):
+            app_hash = self.node.cons_commit(
+                [bytes.fromhex(t) for t in q["block_txs"]],
+                int(q["height"]),
+                int(q["time_ns"]),
+                bytes.fromhex(q["data_root"]),
+                int(q["square_size"]),
+                proposer=bytes.fromhex(q.get("proposer", "") or ""),
+                votes=(
+                    [(bytes.fromhex(a), bool(ok)) for a, ok in votes]
+                    if votes is not None
+                    else None
+                ),
+            )
         return json.dumps({"app_hash": app_hash.hex()}).encode()
 
     # -- two-phase BFT surface (node/bft.py; the relay is dumb transport)
@@ -191,7 +215,18 @@ class NodeService:
         return b"{}"
 
     def bft_msg(self, req: bytes, ctx) -> bytes:
-        self.node.bft_msg(json.loads(req))
+        wire = json.loads(req)
+        # relay-leg trace context rides INSIDE the wire dict (the relay
+        # forwards wires verbatim, so there is no outer envelope to
+        # extend); engines ignore unknown keys, and the context is
+        # stripped before delivery so re-serialized outbox messages never
+        # carry a stale hop's context
+        tc, kind = None, ""
+        if isinstance(wire, dict):  # the only valid wire shape
+            tc = wire.pop("_tc", None)
+            kind = str(wire.get("kind", ""))
+        with tracing.rpc_span("rpc.bft_msg", tc, kind=kind):
+            self.node.bft_msg(wire)
         return b"{}"
 
     def bft_timeout(self, req: bytes, ctx) -> bytes:
@@ -220,6 +255,7 @@ class NodeService:
         injected failure is reported as retriable, exactly like shed
         load — the client cannot tell a chaos drill from real pressure)."""
         if not self.das_gate.try_acquire():
+            self.node.app.telemetry.incr("das_sample_shed")
             tracing.instant("das_sample.shed", cat="serving")
             return json.dumps(
                 {
@@ -229,8 +265,8 @@ class NodeService:
             ).encode()
         try:
             q = json.loads(req or b"{}")
-            with tracing.span(
-                "das_sample", cat="serving",
+            with tracing.rpc_span(
+                "das_sample", q.get("_tc"), cat="serving",
                 height=int(q.get("height", 0) or 0),
                 row=int(q.get("row", 0) or 0),
                 col=int(q.get("col", 0) or 0),
@@ -257,8 +293,51 @@ class NodeService:
         """Prometheus text exposition of the node's telemetry: counters,
         gauges, the bounded log2 histograms, per-span aggregates (when
         tracing is on) and the unified cache registry.  Raw text bytes —
-        point a scraper straight at the RPC."""
-        return self.node.app.telemetry.export_prometheus().encode()
+        point a scraper straight at the RPC.
+
+        Appended sections (all line-parse-valid, the same gate as the
+        core export): client-side RPC counters (this node's OWN outbound
+        pulls — gossip catch-up, state-sync), fault-note/degradation
+        totals (the robustness ladder, so ``cluster-health`` needs no
+        second RPC), and the node identity as an info gauge."""
+        from celestia_tpu.client import remote as remote_mod
+        from celestia_tpu.utils import faults
+        from celestia_tpu.utils.telemetry import escape_label_value
+
+        lines = [self.node.app.telemetry.export_prometheus().rstrip("\n")]
+        client_lines = remote_mod.client_rpc_exposition()
+        if client_lines:
+            lines.extend(client_lines)
+        fs = faults.fault_stats()
+        notes_total = sum(v["count"] for v in fs["notes"].values())
+        lines.append("# TYPE celestia_tpu_fault_notes_total counter")
+        lines.append(f"celestia_tpu_fault_notes_total {notes_total}")
+        lines.append("# TYPE celestia_tpu_degradations_total counter")
+        lines.append(
+            f"celestia_tpu_degradations_total {len(fs['degradations'])}"
+        )
+        nid = tracing.node_id()
+        if nid:
+            lines.append(
+                'celestia_tpu_node_info{node_id="%s"} 1'
+                % escape_label_value(nid)
+            )
+        return ("\n".join(lines) + "\n").encode()
+
+    def clock_probe(self, req: bytes, ctx) -> bytes:
+        """One sanctioned telemetry-clock read for the cross-node
+        midpoint offset probe (utils/tracing.estimate_clock_offset):
+        merged cluster timelines subtract the estimated offset so N
+        nodes' spans line up on one axis."""
+        from celestia_tpu.utils.telemetry import clock
+
+        return json.dumps(
+            {
+                "ts": clock(),
+                "node_id": tracing.node_id(),
+                "height": self.node.height,
+            }
+        ).encode()
 
     def trace_dump(self, req: bytes, ctx) -> bytes:
         """The last N block traces (plus the background ring) as a Chrome
@@ -296,8 +375,10 @@ class NodeService:
             self.node.bft_msg(d["wire"])
             return json.dumps({"new": True}).encode()
         # dedup id is computed engine-side from the wire content; a
-        # sender-supplied id is never trusted
-        new = eng.on_gossip(d["wire"], d.get("sender", ""))
+        # sender-supplied id is never trusted.  "_tc" is the OPTIONAL
+        # envelope trace context (version-tolerant: an old engine simply
+        # never sees it, an old sender simply never sends it)
+        new = eng.on_gossip(d["wire"], d.get("sender", ""), tc=d.get("_tc"))
         return json.dumps({"new": new}).encode()
 
     def tx_have(self, req: bytes, ctx) -> bytes:
@@ -328,10 +409,14 @@ class NodeService:
         d = json.loads(req)
         store = getattr(self.node, "snapshots", None)
         chunk = None
-        if store is not None:
-            chunk = store.chunk_bytes(
-                int(d["height"]), int(d.get("format", 1)), int(d["idx"])
-            )
+        with tracing.rpc_span(
+            "rpc.snapshot_chunk", d.get("_tc"),
+            height=int(d.get("height", 0) or 0), idx=int(d.get("idx", 0) or 0),
+        ):
+            if store is not None:
+                chunk = store.chunk_bytes(
+                    int(d["height"]), int(d.get("format", 1)), int(d["idx"])
+                )
         return json.dumps(
             {"found": chunk is not None,
              "data": chunk.hex() if chunk is not None else ""}
@@ -379,6 +464,7 @@ class NodeService:
             "Query": self.query,
             "Metrics": self.metrics,
             "TraceDump": self.trace_dump,
+            "ClockProbe": self.clock_probe,
             "DasSample": self.das_sample,
             "ConsPrepare": self.cons_prepare,
             "ConsProcess": self.cons_process,
@@ -399,11 +485,35 @@ class NodeService:
         }
         method_handlers = {
             name: grpc.unary_unary_rpc_method_handler(
-                fn, request_deserializer=_identity, response_serializer=_identity
+                self._counted(name, fn),
+                request_deserializer=_identity, response_serializer=_identity
             )
             for name, fn in rpcs.items()
         }
         return grpc.method_handlers_generic_handler(SERVICE, method_handlers)
+
+    def _counted(self, name: str, fn):
+        """Per-RPC byte/count telemetry: ``rpc_{method}_calls`` plus
+        ``rpc_{method}_bytes_{in,out}`` counters on the node's telemetry
+        (three locked dict increments — cheap enough for the gossip
+        flood path, and the cluster-health rollup reads them straight
+        off the Metrics exposition).  The telemetry is read per call,
+        never captured: a state-sync restore REPLACES node.app (and its
+        Telemetry), and counters bound to the old instance would freeze
+        out of the Metrics export."""
+        from celestia_tpu.utils.telemetry import snake_case
+
+        prefix = f"rpc_{snake_case(name)}"
+
+        def handler(req: bytes, ctx, _fn=fn, _p=prefix):
+            t = self.node.app.telemetry
+            t.incr(f"{_p}_calls")
+            t.incr(f"{_p}_bytes_in", len(req) if req else 0)
+            resp = _fn(req, ctx)
+            t.incr(f"{_p}_bytes_out", len(resp) if resp else 0)
+            return resp
+
+        return handler
 
 
 class NodeServer:
@@ -431,6 +541,11 @@ class NodeServer:
         # the gossip engine stamps outbound floods with this (sender
         # exclusion on re-flood)
         node._server_address = self.address
+        # stable node identity for the cross-node trace/metrics planes:
+        # the bind address is unique per mesh member.  First write wins —
+        # CELESTIA_TPU_NODE_ID (pinned at import) or a test override is
+        # never clobbered.
+        tracing.set_node_id(self.address)
         self.block_interval_s = block_interval_s
         self._stop = threading.Event()
         self._producer: Optional[threading.Thread] = None

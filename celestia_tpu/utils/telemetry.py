@@ -170,6 +170,20 @@ def validate_exposition(text: str) -> List[str]:
     return bad
 
 
+def snake_case(name: str) -> str:
+    """CamelCase RPC method name -> metric-safe snake case (Broadcast ->
+    broadcast, DasSample -> das_sample).  The ONE fold shared by the
+    server-side ``rpc_{method}_*`` and client-side
+    ``rpc_client_{method}_*`` counter families — per-method names must
+    line up for the cluster-health rollup to join them."""
+    out = []
+    for i, c in enumerate(name):
+        if c.isupper() and i and not name[i - 1].isupper():
+            out.append("_")
+        out.append(c.lower())
+    return "".join(out)
+
+
 def sanitize_metric_name(name: str) -> str:
     """Fold an internal metric name (dots, dashes, anything) into a
     valid Prometheus metric name; idempotent for already-valid names."""

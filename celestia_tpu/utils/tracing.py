@@ -33,6 +33,25 @@ Design constraints, in order:
 Clock: durations are measured through :func:`telemetry.clock` — the one
 sanctioned wall-clock channel (celint R3) — and only ever feed
 telemetry/trace output, never consensus bytes.
+
+Cross-node tracing (PR 9): the workload is inherently multi-node — one
+block's causal chain is the proposer's prepare, every validator's
+process, gossip dissemination and DAS serving, split across machines.
+This module therefore also carries:
+
+* a stable **node id** (:func:`set_node_id`; ``CELESTIA_TPU_NODE_ID`` or
+  the gRPC bind address) stamped onto every exported event, so merged
+  timelines attribute spans/faults to the right machine;
+* a compact **wire trace context** (:func:`wire_context` — origin node
+  id, parent span id, height, send timestamp) that rides cross-node RPC
+  envelopes as an optional ``"_tc"`` field old peers silently ignore;
+  the receiving side opens an :func:`rpc_span` that records the remote
+  parent EXPLICITLY (``remote_node``/``remote_span`` args — local span
+  ids are per-process, so cross-node parentage is by (node, span) pair,
+  resolved into Chrome flow events by ``tools/trace_merge.py``);
+* a **clock-offset probe** (:func:`estimate_clock_offset` — RPC midpoint
+  method over this module's sanctioned clock) so N nodes' dumps merge
+  onto one aligned timeline.
 """
 
 from __future__ import annotations
@@ -48,10 +67,34 @@ from celestia_tpu.utils.telemetry import Log2Histogram, clock
 
 ENV_FLAG = "CELESTIA_TPU_TRACE"
 ENV_BLOCKS = "CELESTIA_TPU_TRACE_BLOCKS"
+ENV_NODE_ID = "CELESTIA_TPU_NODE_ID"
 
 DEFAULT_MAX_BLOCKS = 8
 MAX_SPANS_PER_BLOCK = 8192
 MAX_BACKGROUND_SPANS = 2048
+
+# ---------------------------------------------------------------------------
+# node identity (cross-node attribution)
+# ---------------------------------------------------------------------------
+
+# the stable identity of THIS process in a mesh: stamped onto every
+# exported trace event and carried as the origin of outbound trace
+# contexts.  Set once (env wins over code); empty = single-node.
+_node_id = ""
+
+
+def set_node_id(node_id: str, force: bool = False) -> None:
+    """Set this process's node id (first write wins unless ``force``):
+    the NodeServer sets its bind address at start, the env var overrides
+    at import, tests force their own."""
+    global _node_id
+    if _node_id and not force:
+        return
+    _node_id = str(node_id)[:128]
+
+
+def node_id() -> str:
+    return _node_id
 
 # ---------------------------------------------------------------------------
 # spans
@@ -283,10 +326,24 @@ class Tracer:
     def block_span(self, name: str, height: int, **args):
         """A per-height ROOT span: opens a fresh :class:`BlockTrace`
         that collects every descendant span; the trace enters the ring
-        when this span ends."""
+        when this span ends.
+
+        The root's parent_id stays 0 (a block trace is its own tree),
+        but when an enclosing span is active — e.g. the server-side
+        ``rpc.*`` span a cross-node RPC opened — its id is recorded as
+        ``link_span_id`` and any remote-origin args it carries
+        (``remote_node``/``remote_span``/``remote_send_ts``) are
+        inherited, so the proposer's prepare on node A links explicitly
+        to the validator's process root on node B."""
         if not self.enabled:
             return NULL_SPAN
         s = Span(name, "block", 0, None, {"height": height, **args})
+        enc = _current.get()
+        if enc is not None:
+            s.args.setdefault("link_span_id", enc.span_id)
+            for k in ("remote_node", "remote_span", "remote_send_ts"):
+                if k in enc.args:
+                    s.args.setdefault(k, enc.args[k])
         s._sink = BlockTrace(name, height, s.span_id)
         return _SpanCtx(self, s)
 
@@ -428,6 +485,15 @@ class Tracer:
             for s in tr.spans:
                 seen_threads.setdefault(s.tid, s.thread_name)
         events.extend(background)
+        nid = _node_id
+        if nid:
+            # tag every span with the stable node id (cross-node merge
+            # attribution).  Background events are the live ring's dicts;
+            # copy before stamping so the export never mutates the ring.
+            events = [
+                dict(ev, args=dict(ev.get("args", {}), node_id=nid))
+                for ev in events
+            ]
         meta = [
             {
                 "ph": "M",
@@ -438,11 +504,22 @@ class Tracer:
             }
             for tid, tname in sorted(seen_threads.items())
         ]
+        if nid:
+            meta.insert(
+                0,
+                {
+                    "ph": "M",
+                    "name": "process_name",
+                    "pid": 1,
+                    "args": {"name": nid},
+                },
+            )
         return {
             "displayTimeUnit": "ms",
             "traceEvents": meta + events,
             "otherData": {
                 "tracer": "celestia-tpu",
+                "node_id": nid,
                 "blocks": [
                     {
                         "name": tr.name,
@@ -601,6 +678,133 @@ def block_traces(last: Optional[int] = None) -> List[BlockTrace]:
     return TRACER.block_traces(last)
 
 
+# ---------------------------------------------------------------------------
+# cross-node trace context (the "_tc" wire field)
+# ---------------------------------------------------------------------------
+#
+# Local span ids are a per-process monotonic count, so cross-node
+# parentage can never be a bare id: the wire context names the ORIGIN
+# (node id) + the parent span id within that origin, and the merge tool
+# resolves (node, span) pairs into Chrome flow events.  The context is
+# a plain JSON-safe dict with compact keys:
+#
+#   {"n": origin node id, "s": parent span id (0 = none),
+#    "h": height (0 = n/a), "t": send timestamp (telemetry clock)}
+#
+# It rides cross-node RPC envelopes as an OPTIONAL "_tc" field that
+# un-upgraded peers ignore (their handlers read named keys); a missing,
+# truncated or malformed context degrades to "no remote parent" — never
+# an error, never a leaked span.
+
+
+def wire_context(height: int = 0) -> Optional[dict]:
+    """The compact trace context of the CURRENT logical call site, for
+    attaching to an outbound cross-node RPC.  None when tracing is off
+    (the envelope then carries no ``_tc`` at all — zero bytes, zero
+    cost on the gossip hot path)."""
+    if not _enabled:
+        return None
+    cur = _current.get()
+    return {
+        "n": _node_id,
+        "s": cur.span_id if cur is not None else 0,
+        "h": int(height or 0),
+        "t": round(clock(), 6),
+    }
+
+
+def last_block_context(name: Optional[str] = None) -> Optional[dict]:
+    """Wire context anchored to the newest completed block trace
+    (optionally of a given root name): how a proposer hands the span id
+    of its *prepare* root to the coordinator, which forwards it to every
+    validator's *process* leg."""
+    if not _enabled:
+        return None
+    for tr in reversed(TRACER.block_traces()):
+        if name is None or tr.name == name:
+            return {
+                "n": _node_id,
+                "s": tr.root_id,
+                "h": tr.height,
+                "t": round(clock(), 6),
+            }
+    return None
+
+
+def _context_args(tc) -> dict:
+    """Remote-origin span args from a received wire context.  Malformed
+    or version-mismatched contexts (old peers, hostile bytes) fold to
+    {} — mixed-version meshes must keep working.  A context with no
+    parent span (``s`` 0 — e.g. a gossip flood drained from the outbox
+    outside any span) still attributes the ORIGIN node; only a valid
+    span id adds the flow-linkable ``remote_span``."""
+    if not isinstance(tc, dict) or not isinstance(tc.get("n"), str):
+        return {}
+    try:
+        origin = tc["n"][:128]
+        span_id = int(tc.get("s", 0) or 0)
+        send_ts = float(tc.get("t", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        return {}
+    if not origin:
+        return {}
+    out = {"remote_node": origin}
+    if span_id > 0:
+        out["remote_span"] = span_id
+    if send_ts > 0.0:
+        out["remote_send_ts"] = round(send_ts, 6)
+    return out
+
+
+def rpc_span(name: str, tc=None, cat: str = "rpc", **args):
+    """Server-side span for a cross-node RPC: like :func:`span`, but
+    records the caller's context as explicit ``remote_node``/
+    ``remote_span`` args (local parentage still rides the contextvar).
+    A block trace opened inside it inherits the remote link onto its
+    root (see :meth:`Tracer.block_span`)."""
+    if not _enabled:
+        return NULL_SPAN
+    return TRACER.span(name, cat=cat, **{**_context_args(tc), **args})
+
+
+# ---------------------------------------------------------------------------
+# clock alignment (RPC midpoint offset probe)
+# ---------------------------------------------------------------------------
+
+
+def estimate_clock_offset(probe_fn, samples: int = 5) -> dict:
+    """Estimate a peer's clock offset by the RPC midpoint method.
+
+    ``probe_fn()`` performs one round trip and returns the PEER's
+    telemetry-clock timestamp (seconds).  For each sample the peer time
+    is compared against the midpoint of the local send/receive stamps —
+    the standard symmetric-delay estimator — and the sample with the
+    smallest RTT wins (least queueing noise).  All local stamps come
+    from the sanctioned telemetry ``clock()`` (celint R3: this module is
+    a sanctioned channel).
+
+    Returns ``{"offset_s", "rtt_s", "samples"}`` where ``offset_s`` is
+    *peer clock minus local clock*: subtract it from the peer's
+    timestamps to land them on the local timeline."""
+    best_rtt = float("inf")
+    best_offset = 0.0
+    n = 0
+    for _ in range(max(1, int(samples))):
+        t0 = clock()
+        peer_ts = float(probe_fn())
+        t1 = clock()
+        rtt = max(0.0, t1 - t0)
+        n += 1
+        if rtt < best_rtt:
+            best_rtt = rtt
+            best_offset = peer_ts - (t0 + t1) / 2.0
+    return {
+        "offset_s": round(best_offset, 6),
+        "rtt_s": round(best_rtt, 6),
+        "samples": n,
+    }
+
+
 def validate_chrome_trace(dump: dict) -> List[str]:
     """Schema check of a trace_dump() document (the trace-smoke gate):
     returns a list of problems, empty when the JSON is a well-formed
@@ -618,7 +822,7 @@ def validate_chrome_trace(dump: dict) -> List[str]:
             problems.append(f"event {i} is not an object")
             continue
         ph = ev.get("ph")
-        if ph not in ("X", "i", "M", "B", "E", "b", "e"):
+        if ph not in ("X", "i", "M", "B", "E", "b", "e", "s", "t", "f"):
             problems.append(f"event {i} has unknown phase {ph!r}")
             continue
         if ph == "M":
@@ -632,6 +836,8 @@ def validate_chrome_trace(dump: dict) -> List[str]:
             problems.append(f"complete event {i} ({ev.get('name')}) lacks dur")
         if ph in ("b", "e") and "id" not in ev:
             problems.append(f"async event {i} ({ev.get('name')}) lacks id")
+        if ph in ("s", "t", "f") and "id" not in ev:
+            problems.append(f"flow event {i} ({ev.get('name')}) lacks id")
         if not isinstance(ev.get("ts", 0), (int, float)):
             problems.append(f"event {i} ts is not numeric")
     try:
@@ -705,9 +911,14 @@ def _arm_from_env() -> None:
     node needs no code changes, same contract as the faults registry.
     CELESTIA_TPU_TRACE_BLOCKS alone also enables (mirroring the CLI,
     where --trace-blocks implies --trace: sizing a ring you did not
-    turn on must not be a silent no-op)."""
+    turn on must not be a silent no-op).  CELESTIA_TPU_NODE_ID pins the
+    node identity regardless of tracing state (the metrics plane tags
+    by it too)."""
     import os
 
+    nid = os.environ.get(ENV_NODE_ID, "").strip()
+    if nid:
+        set_node_id(nid, force=True)
     flag = os.environ.get(ENV_FLAG, "").strip().lower()
     blocks = os.environ.get(ENV_BLOCKS, "").strip()
     try:

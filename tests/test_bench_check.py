@@ -1,0 +1,156 @@
+"""The bench regression watchdog (tools/bench_check.py, `make
+bench-check`): the recorded BENCH_r01..r05 trajectory must pass, a
+synthetic regressed round must fail loudly, and the comparison
+semantics (per-metric series, best-so-far, direction, tolerance,
+unparsed rounds) are pinned here."""
+
+import importlib.util
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+_spec = importlib.util.spec_from_file_location(
+    "bench_check", REPO / "tools" / "bench_check.py"
+)
+bench_check = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench_check)
+
+
+def _round(n, metric=None, value=None, extras=None, parsed=True):
+    doc = {"n": n, "cmd": "bench", "rc": 0, "tail": ""}
+    if not parsed:
+        doc["parsed"] = None
+    else:
+        doc["parsed"] = {
+            "metric": metric or "extend_block_128x128_p50_device_ms",
+            "value": value if value is not None else 10.0,
+            "unit": "ms",
+            "extras": extras or {},
+        }
+    return doc
+
+
+def _write_rounds(tmp_path, rounds):
+    for i, doc in enumerate(rounds, start=1):
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(json.dumps(doc))
+
+
+def test_recorded_trajectory_passes():
+    """Acceptance: `make bench-check` on the real BENCH_r01..r05 files."""
+    out = subprocess.run(
+        [sys.executable, "tools/bench_check.py"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rep["bench_check"] == "ok"
+    assert rep["metrics_checked"] > 0
+    # the crashed r04 run contributes nothing but is reported, not hidden
+    assert "BENCH_r04" in rep["unparsed_rounds"]
+
+
+def test_synthetic_regression_fails_loud(tmp_path):
+    """Acceptance: a regressed round must exit non-zero and NAME the
+    regressed metric."""
+    for f in sorted(REPO.glob("BENCH_r*.json")):
+        shutil.copy(f, tmp_path / f.name)
+    reg = _round(
+        6,
+        metric="extend_block_128x128_p50_device_ms",
+        value=40.0,  # best so far is ~8.4 ms
+        extras={"filter_512_pfb_ms": 500.0},  # best so far 83.3 ms
+    )
+    (tmp_path / "BENCH_r06.json").write_text(json.dumps(reg))
+    out = subprocess.run(
+        [sys.executable, "tools/bench_check.py", "--dir", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 1
+    assert "REGRESSION" in out.stderr
+    assert "extend_block_128x128_p50_device_ms" in out.stderr
+    assert "filter_512_pfb_ms" in out.stderr
+
+
+def test_lower_is_better_tolerance_boundary(tmp_path):
+    _write_rounds(tmp_path, [
+        _round(1, value=10.0),
+        _round(2, value=12.4),  # within the 25% tolerance of best=10
+    ])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+    _write_rounds(tmp_path, [
+        _round(1, value=10.0),
+        _round(2, value=12.6),  # past the tolerance
+    ])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_warm_speedup_higher_is_better(tmp_path, capsys):
+    extras_good = {"prepare_then_process_128tx_ms": {
+        "cold_ms": 300.0, "warm_ms": 80.0, "warm_speedup": 4.0}}
+    extras_bad = {"prepare_then_process_128tx_ms": {
+        "cold_ms": 300.0, "warm_ms": 290.0, "warm_speedup": 1.05}}
+    _write_rounds(tmp_path, [
+        _round(1, extras=extras_good),
+        _round(2, extras=extras_bad),
+    ])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "warm_speedup" in err and "higher" in err
+    # an IMPROVED speedup passes
+    _write_rounds(tmp_path, [
+        _round(1, extras=extras_bad),
+        _round(2, extras=extras_good),
+    ])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_unparsed_rounds_are_skipped_not_zeroed(tmp_path):
+    _write_rounds(tmp_path, [
+        _round(1, value=10.0),
+        _round(2, parsed=False),  # crashed bench run
+        _round(3, value=9.0),
+    ])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_different_metric_names_never_cross_compare(tmp_path):
+    """A device round followed by a CPU-leg round (different headline
+    metric names) is NOT a regression — the r05 situation."""
+    _write_rounds(tmp_path, [
+        _round(1, metric="extend_block_128x128_p50_device_ms", value=8.4),
+        _round(2, metric="extend_block_128x128_leopard_cpu_ms", value=127.5),
+    ])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_needs_two_parseable_rounds(tmp_path):
+    _write_rounds(tmp_path, [_round(1), _round(2, parsed=False)])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 2
+
+
+def test_check_series_semantics():
+    rounds = [
+        ("r1", {"m_ms": (10.0, False), "only_r1_ms": (5.0, False)}),
+        ("r2", {"m_ms": (8.0, False)}),
+        ("r3", {"m_ms": (8.5, False)}),
+    ]
+    regressions, summary = bench_check.check(rounds, tolerance=0.25)
+    assert regressions == []
+    assert summary["m_ms"]["best"] == 8.0
+    assert summary["m_ms"]["best_round"] == "r2"
+    assert summary["m_ms"]["last"] == 8.5
+    # single-occurrence metrics have no baseline to regress against
+    assert summary["only_r1_ms"]["ratio"] == 1.0
+    regressions, _ = bench_check.check(
+        [("r1", {"m_ms": (8.0, False)}), ("r2", {"m_ms": (11.0, False)})],
+        tolerance=0.25,
+    )
+    assert len(regressions) == 1
+    assert regressions[0]["metric"] == "m_ms"
+    assert regressions[0]["ratio"] == pytest.approx(1.375)

@@ -323,6 +323,50 @@ def test_r3_channel_scan_does_not_leak_to_other_utils():
     assert _ids(_lint(R3_CHANNEL_ENTROPY_BAD, "celestia_tpu/utils/x.py")) == []
 
 
+# the clock-offset probe (PR 9): the RPC midpoint estimator reads the
+# wall clock twice per sample — sanctioned INSIDE the channel modules
+# (tracing.estimate_clock_offset lives there), a finding anywhere a
+# consensus module tries to hand-roll it
+
+R3_OFFSET_PROBE = """
+    import time
+
+
+    def estimate_clock_offset(probe_fn):
+        t0 = time.time()
+        peer_ts = probe_fn()
+        t1 = time.time()
+        return peer_ts - (t0 + t1) / 2.0
+"""
+
+R3_OFFSET_PROBE_VIA_CHANNEL = """
+    from celestia_tpu.utils.telemetry import clock
+
+
+    def estimate_clock_offset(probe_fn):
+        t0 = clock()
+        peer_ts = probe_fn()
+        t1 = clock()
+        return peer_ts - (t0 + t1) / 2.0
+"""
+
+
+def test_r3_offset_probe_sanctioned_in_channel_modules():
+    # the probe's direct clock reads are the design inside the channel
+    assert _ids(_lint(R3_OFFSET_PROBE, "celestia_tpu/utils/tracing.py")) == []
+
+
+def test_r3_offset_probe_flagged_in_consensus_modules():
+    # a consensus module hand-rolling the midpoint probe reads the wall
+    # clock twice: two findings, not a silent pass
+    got = _ids(_lint(R3_OFFSET_PROBE, "celestia_tpu/da/fixture.py"))
+    assert got == ["consensus-determinism"] * 2, got
+    # routed through the sanctioned clock() it is clean anywhere
+    assert _ids(
+        _lint(R3_OFFSET_PROBE_VIA_CHANNEL, "celestia_tpu/da/fixture.py")
+    ) == []
+
+
 # ---------------------------------------------------------------------------
 # R4 hostpool-discipline
 # ---------------------------------------------------------------------------

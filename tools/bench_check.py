@@ -1,0 +1,198 @@
+"""bench_check: the bench-regression watchdog (`make bench-check`).
+
+Reads the BENCH_r*.json trajectory and compares every headline metric's
+LATEST recorded value against the best value any EARLIER round recorded
+for the same metric name, with a stated tolerance.  Exits loud (rc 1,
+one line per regression) when the latest value is worse than
+best-so-far by more than the tolerance; rc 0 with a summary JSON line
+otherwise.
+
+What counts as a headline metric (see BASELINE.md for meanings):
+
+* ``parsed.value`` under its ``parsed.metric`` name (the round's
+  headline figure — device and CPU legs are DIFFERENT metric names, so
+  a round that ran without a device never "regresses" the device
+  series),
+* flat ``extras`` entries matching the latency families
+  (``extend_block_*_ms``, ``prepare_*_ms``, ``filter_*_ms``,
+  ``repair_*_ms``, ``transfer_overhead_ms``, ``glv_us_per_sig``,
+  ``leopard_extension_only_ms``) — lower is better,
+* nested ``prepare_then_process_*`` blocks: ``warm_speedup`` (HIGHER is
+  better) and ``cold_ms``/``warm_ms`` (lower).
+
+Rounds whose ``parsed`` is null (a crashed bench run) contribute no
+values; they are counted and reported, never treated as zeros.
+
+Usage:
+    python tools/bench_check.py [--dir REPO] [--tolerance 0.25] [files...]
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+LOWER_IS_BETTER = tuple(
+    re.compile(p)
+    for p in (
+        r"^extend_block_.*_ms$",
+        r"^prepare_.*_ms$",
+        r"^filter_.*_ms$",
+        r"^repair_.*_ms$",
+        r"^transfer_overhead_ms$",
+        r"^glv_us_per_sig$",
+        r"^leopard_extension_only_ms$",
+    )
+)
+
+# metric name -> True when HIGHER values are better
+_HIGHER = {"warm_speedup"}
+
+
+def _flat_headlines(parsed: dict):
+    """Yield (metric, value, higher_is_better) from one round's parsed
+    bench document."""
+    metric = parsed.get("metric")
+    value = parsed.get("value")
+    if isinstance(metric, str) and isinstance(value, (int, float)):
+        yield metric, float(value), False
+    extras = parsed.get("extras") or {}
+    for key, val in extras.items():
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            if any(p.match(key) for p in LOWER_IS_BETTER):
+                yield key, float(val), False
+        elif isinstance(val, dict) and key.startswith("prepare_then_process"):
+            for sub in ("warm_speedup", "cold_ms", "warm_ms"):
+                v = val.get(sub)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    yield f"{key}.{sub}", float(v), sub in _HIGHER
+
+
+def load_trajectory(paths):
+    """[(round_name, {metric: (value, higher_better)})] in round order,
+    plus the list of rounds whose bench run produced no parse."""
+    rounds, unparsed = [], []
+    for path in paths:
+        name = os.path.splitext(os.path.basename(path))[0]
+        with open(path) as f:
+            doc = json.load(f)
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict):
+            unparsed.append(name)
+            continue
+        metrics = {}
+        for metric, value, higher in _flat_headlines(parsed):
+            metrics[metric] = (value, higher)
+        rounds.append((name, metrics))
+    return rounds, unparsed
+
+
+def check(rounds, tolerance: float):
+    """Compare each metric's last recorded value vs its best-so-far.
+    Returns (regressions, series) where series maps metric ->
+    {"best", "best_round", "last", "last_round", "ratio"}."""
+    series = {}
+    for rnd, metrics in rounds:
+        for metric, (value, higher) in metrics.items():
+            series.setdefault(metric, []).append((rnd, value, higher))
+    regressions = []
+    summary = {}
+    for metric, points in sorted(series.items()):
+        *earlier, (last_round, last, higher) = points
+        if not earlier:
+            summary[metric] = {
+                "last": last, "last_round": last_round,
+                "best": last, "best_round": last_round, "ratio": 1.0,
+            }
+            continue
+        values = [v for _, v, _ in earlier]
+        if higher:
+            best_i = max(range(len(values)), key=values.__getitem__)
+            best = values[best_i]
+            # a HIGHER metric regresses when the latest falls below
+            # best * (1 - tolerance)
+            bad = last < best * (1.0 - tolerance)
+            ratio = (last / best) if best else 1.0
+        else:
+            best_i = min(range(len(values)), key=values.__getitem__)
+            best = values[best_i]
+            bad = last > best * (1.0 + tolerance)
+            ratio = (last / best) if best else 1.0
+        summary[metric] = {
+            "last": last, "last_round": last_round,
+            "best": best, "best_round": earlier[best_i][0],
+            "ratio": round(ratio, 3),
+        }
+        if bad:
+            regressions.append(
+                {
+                    "metric": metric,
+                    "direction": "higher" if higher else "lower",
+                    "best": best,
+                    "best_round": earlier[best_i][0],
+                    "last": last,
+                    "last_round": last_round,
+                    "ratio": round(ratio, 3),
+                    "tolerance": tolerance,
+                }
+            )
+    return regressions, summary
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="bench_check")
+    p.add_argument("files", nargs="*",
+                   help="BENCH json files in round order (default: "
+                        "--dir/BENCH_r*.json sorted)")
+    p.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    p.add_argument("--tolerance", type=float, default=0.25,
+                   help="allowed fractional slack vs best-so-far "
+                        "(default 0.25 = 25%%)")
+    args = p.parse_args(argv)
+    paths = args.files or sorted(
+        glob.glob(os.path.join(args.dir, "BENCH_r*.json"))
+    )
+    if len(paths) < 2:
+        print(f"bench_check: need >= 2 rounds, found {len(paths)}",
+              file=sys.stderr)
+        return 2
+    rounds, unparsed = load_trajectory(paths)
+    if len(rounds) < 2:
+        print(
+            f"bench_check: only {len(rounds)} parseable rounds "
+            f"({len(unparsed)} unparsed: {unparsed})",
+            file=sys.stderr,
+        )
+        return 2
+    regressions, summary = check(rounds, args.tolerance)
+    if regressions:
+        for r in regressions:
+            print(
+                "bench_check: REGRESSION %s: %s=%s (%s) vs best %s (%s), "
+                "ratio %s > tolerance %s"
+                % (
+                    r["direction"], r["metric"], r["last"], r["last_round"],
+                    r["best"], r["best_round"], r["ratio"], r["tolerance"],
+                ),
+                file=sys.stderr,
+            )
+        return 1
+    print(
+        json.dumps(
+            {
+                "bench_check": "ok",
+                "rounds": [r for r, _ in rounds],
+                "unparsed_rounds": unparsed,
+                "metrics_checked": len(summary),
+                "tolerance": args.tolerance,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test chaos trace-smoke bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
+.PHONY: lint test chaos trace-smoke profile-smoke bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
 
 ## celint: concurrency & determinism static analysis (exit 1 on findings)
 lint:
@@ -28,6 +28,16 @@ chaos:
 ## Perfetto timeline with a non-empty cross-node link)
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/trace_smoke.py
+
+## device-observability boot gate: a traced tiny-k block must yield a
+## schema-valid merged HOST+DEVICE Chrome trace (per-chip device track),
+## an XLA cost row, a parseable >=2-snapshot time-series dump and one
+## deliberately-tripped alert rule firing; then a one-node leg drives
+## the real `query timeseries` / `query alerts` CLI against a
+## synthetically height-stalled validator and scrapes plain-HTTP
+## /metrics (tier-1 runs the same assertions via tests/test_profile_smoke.py)
+profile-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/profile_smoke.py
 
 ## bench regression watchdog: compares every headline metric's latest
 ## BENCH_r*.json value against best-so-far (25% tolerance); exits loud

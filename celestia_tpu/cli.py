@@ -304,6 +304,40 @@ def cmd_start(args) -> int:
             sizes=",".join(map(str, warm_sizes)),
             seconds=round(time.time() - t_warm, 1),
         )
+    device_profile_dir = None
+    # CELESTIA_TPU_DEVICE_PROFILE is the env equivalent of the flag
+    # (same contract as CELESTIA_TPU_TRACE): the flag wins when both
+    # are present; truthy values mean "capture into the default dir",
+    # explicit falsy values ("0"/"false"/"no"/"off") mean OFF — an
+    # operator overriding an orchestration template must not end up
+    # capturing into a directory literally named ./0 — anything else
+    # is the capture directory itself
+    env_profile = os.environ.get("CELESTIA_TPU_DEVICE_PROFILE", "").strip()
+    flag_profile = getattr(args, "device_profile", None)
+    if flag_profile is None and env_profile:
+        low = env_profile.lower()
+        if low in ("1", "true", "yes", "on"):
+            flag_profile = ""
+        elif low not in ("0", "false", "no", "off"):
+            flag_profile = env_profile
+    if flag_profile is not None:
+        # optional XLA profiler capture (utils/devprof.py): TensorBoard/
+        # XPlane per-op device timelines next to the Chrome device track.
+        # Degrades to a logged note on platforms that cannot capture —
+        # the flag without a TPU must never kill the node.
+        from celestia_tpu.utils import devprof
+
+        device_profile_dir = flag_profile or str(
+            Path(home) / "data" / "device-profile"
+        )
+        if devprof.start_profiler(device_profile_dir):
+            log.info("device profiler capturing", dir=device_profile_dir)
+        else:
+            log.warn(
+                "device profiler unavailable on this platform; "
+                "continuing without capture"
+            )
+            device_profile_dir = None
     server = NodeServer(
         node,
         address=cfg.grpc.address,
@@ -313,8 +347,14 @@ def cmd_start(args) -> int:
             if args.validator or getattr(args, "bft_valset", None)
             else cfg.consensus.block_interval_s
         ),
+        # plain-HTTP /metrics for a stock Prometheus (off by default)
+        metrics_port=getattr(args, "metrics_port", None),
+        # continuous telemetry snapshots (0 disables the sampler)
+        timeseries_interval_s=getattr(args, "timeseries_interval", 5.0),
     )
     server.start()
+    if server.metrics_http is not None:
+        log.info("metrics HTTP endpoint", address=server.metrics_http.address)
     gossip = None
     if getattr(args, "peers", None) and getattr(args, "bft_valset", None):
         # p2p mesh mode: flood consensus messages directly between
@@ -336,7 +376,20 @@ def cmd_start(args) -> int:
         grpc=server.address,
         block_interval_s=cfg.consensus.block_interval_s,
     )
-    print(json.dumps({"grpc": server.address, "chain_id": node.chain_id}), flush=True)
+    print(
+        json.dumps(
+            {
+                "grpc": server.address,
+                "chain_id": node.chain_id,
+                **(
+                    {"metrics_http": server.metrics_http.address}
+                    if server.metrics_http is not None
+                    else {}
+                ),
+            }
+        ),
+        flush=True,
+    )
     try:
         while True:
             # celint: allow(sanctioned-retry) — the serve command's idle park; all work happens on server/gossip threads
@@ -346,6 +399,12 @@ def cmd_start(args) -> int:
         if gossip is not None:
             gossip.stop()
         server.stop()
+        if device_profile_dir is not None:
+            from celestia_tpu.utils import devprof
+
+            stopped = devprof.stop_profiler()
+            if stopped:
+                log.info("device profiler capture written", dir=stopped)
     return 0
 
 
@@ -533,6 +592,30 @@ def cmd_query(args) -> int:
     elif args.query_cmd == "metrics":
         # raw Prometheus text — pipe it to a file or a scraper probe
         sys.stdout.write(node.metrics())
+    elif args.query_cmd == "timeseries":
+        # the continuous-telemetry ring: snapshots + per-metric rates
+        # (the server records one fresh sample per call, so repeated
+        # queries always have a computable derivative)
+        out = node.time_series(last=args.last or None)
+        print(json.dumps({
+            "node_id": out.get("node_id", ""),
+            "samples_kept": out.get("samples_kept", 0),
+            "max_samples": out.get("max_samples", 0),
+            "snapshots": out.get("snapshots", []),
+            "rates": out.get("rates", {}),
+        }, indent=1 if args.pretty else None))
+    elif args.query_cmd == "alerts":
+        # the declarative alert engine's verdicts over the same ring
+        out = node.time_series(last=1)
+        alerts = out.get("alerts", [])
+        firing = [a for a in alerts if a.get("firing")]
+        print(json.dumps({
+            "node_id": out.get("node_id", ""),
+            "firing": len(firing),
+            "alerts": firing if args.firing_only else alerts,
+        }, indent=1))
+        if firing and args.fail_on_firing:
+            return 1
     elif args.query_cmd == "trace-dump":
         out = node.trace_dump(last=args.last or None)
         if args.out:
@@ -1264,6 +1347,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="how many recent block traces the ring keeps (default 8; "
              "CELESTIA_TPU_TRACE_BLOCKS is equivalent)",
     )
+    sp.add_argument(
+        "--metrics-port", type=int, default=None, metavar="PORT",
+        help="serve the Prometheus exposition as plain HTTP GET /metrics "
+             "on this port (0 = ephemeral; off by default — the Metrics "
+             "RPC keeps serving either way)",
+    )
+    sp.add_argument(
+        "--device-profile", nargs="?", const="", default=None, metavar="DIR",
+        help="capture a jax.profiler (TensorBoard/XPlane) device trace "
+             "into DIR (default: <home>/data/device-profile) for the "
+             "node's lifetime; degrades to a logged note without a "
+             "capturable device",
+    )
+    sp.add_argument(
+        "--timeseries-interval", type=float, default=5.0, metavar="SECONDS",
+        help="continuous-telemetry snapshot cadence for the TimeSeries "
+             "ring + alert engine (0 disables the sampler; the RPC "
+             "still samples on demand)",
+    )
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser(
@@ -1378,6 +1480,24 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("validator")
     qs.add_parser("invariants")
     qs.add_parser("metrics", help="node Prometheus text exposition")
+    q = qs.add_parser(
+        "timeseries",
+        help="continuous-telemetry snapshots + per-metric rates "
+             "(the bounded TimeSeries ring)",
+    )
+    q.add_argument("--last", type=int, default=0,
+                   help="only the most recent N snapshots (0 = all kept)")
+    q.add_argument("--pretty", action="store_true",
+                   help="indent the JSON output")
+    q = qs.add_parser(
+        "alerts",
+        help="declarative alert-rule verdicts over the telemetry ring "
+             "(threshold / sustained-burn / rate / stall rules)",
+    )
+    q.add_argument("--firing-only", action="store_true",
+                   help="print only the rules currently firing")
+    q.add_argument("--fail-on-firing", action="store_true",
+                   help="exit 1 when any rule fires (CI/automation probe)")
     q = qs.add_parser(
         "trace-dump",
         help="last N block traces as Chrome trace JSON (open in Perfetto)",

@@ -180,6 +180,17 @@ class RemoteNode:
             payload["last"] = int(last)
         return self._call_json("TraceDump", payload)
 
+    def time_series(self, last: Optional[int] = None) -> dict:
+        """The node's continuous-telemetry ring + alert verdicts (the
+        ``TimeSeries`` RPC): ``{"snapshots", "rates", "alerts",
+        "samples_kept", ...}``.  The server records one fresh sample per
+        call, so calling twice always yields >= 2 snapshots with a
+        computable rate."""
+        payload: dict = {}
+        if last is not None:
+            payload["last"] = int(last)
+        return self._call_json("TimeSeries", payload)
+
     def clock_probe(self) -> dict:
         """One peer telemetry-clock read: ``{"ts", "node_id",
         "height"}`` (the ClockProbe RPC)."""

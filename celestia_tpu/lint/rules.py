@@ -283,6 +283,12 @@ _TIME_FNS = {
 SANCTIONED_CHANNELS = (
     "celestia_tpu/utils/telemetry.py",
     "celestia_tpu/utils/tracing.py",
+    # the device half of the plane (PR 11): dispatch brackets and the
+    # occupancy window read the clock; its span/track identifiers must
+    # stay as deterministic as the tracer's, so the entropy bans apply
+    "celestia_tpu/utils/devprof.py",
+    # the continuous-telemetry ring stamps snapshot timestamps
+    "celestia_tpu/utils/timeseries.py",
 )
 
 

@@ -257,6 +257,7 @@ def _peer_health(client) -> dict:
     cache_hits: Dict[str, float] = {}
     cache_misses: Dict[str, float] = {}
     rpc: Dict[str, dict] = {}
+    alerts: Dict[str, bool] = {}
     node_info = ""
     for name, labels, value in samples:
         if name == "celestia_tpu_node_info":
@@ -265,6 +266,8 @@ def _peer_health(client) -> dict:
             cache_hits[labels.get("cache", "?")] = value
         elif name == "celestia_tpu_cache_misses_total":
             cache_misses[labels.get("cache", "?")] = value
+        elif name == "celestia_tpu_alert_firing":
+            alerts[labels.get("rule", "?")] = bool(value)
         elif name.startswith("celestia_tpu_rpc_"):
             m = re.match(
                 r"celestia_tpu_rpc_(client_)?(\w+?)_"
@@ -314,6 +317,20 @@ def _peer_health(client) -> dict:
         ),
         "caches": caches,
         "rpc": rpc,
+        # trace-ring health (PR 11 satellite): silent span truncation
+        # and a ballooning background ring on a busy node are now
+        # visible from the scrape, not only in a local dump
+        "trace": {
+            "span_drops": int(
+                by_name.get("celestia_tpu_trace_span_drops_total", 0)
+            ),
+            "background_depth": int(
+                by_name.get("celestia_tpu_trace_background_depth", 0)
+            ),
+        },
+        # declarative alert states (utils/timeseries.py): rule -> firing
+        "alerts": alerts,
+        "alerts_firing": sum(1 for v in alerts.values() if v),
     }
 
 
@@ -363,6 +380,16 @@ def cluster_health(clients, probes: int = 3) -> dict:
         "degradations": sum(p["degradations"] for p in healthy),
         "das_shed": sum(p["das_shed"] for p in healthy),
         "fault_notes": sum(p["fault_notes"] for p in healthy),
+        # mesh-wide degradation flags (PR 11): summed trace truncation
+        # and every peer with at least one firing alert rule — the
+        # degrading node is NAMED across the mesh, not observed post-hoc
+        "trace_span_drops": sum(
+            p.get("trace", {}).get("span_drops", 0) for p in healthy
+        ),
+        "alerts_firing": sum(p.get("alerts_firing", 0) for p in healthy),
+        "degraded_peers": sorted(
+            p["node_id"] for p in healthy if p.get("alerts_firing", 0) > 0
+        ),
         "collector_node_id": tracing.node_id(),
     }
 

@@ -20,14 +20,25 @@ Methods (service ``celestia.tpu.v1.Node``):
   Metrics      {}                         -> Prometheus text exposition
                (counters, gauges, bounded histograms, cache registry —
                comet's DefaultMetricsProvider role — plus per-RPC
-               byte/call counters, client-side RPC counters and
-               fault/degradation totals)
+               byte/call counters, client-side RPC counters,
+               fault/degradation totals, device-plane gauges
+               (celestia_tpu_xla_* / celestia_tpu_device_*), trace-ring
+               health and alert states)
   TraceDump    {"last": N}                -> the last N block traces as
                Chrome trace-event JSON (utils/tracing.py; open the
                ``trace`` value directly in Perfetto)
   ClockProbe   {}                         -> {"ts", "node_id", "height"}:
                one telemetry-clock read for the cross-node midpoint
                offset probe (tracing.estimate_clock_offset)
+  TimeSeries   {"last": N}                -> {"snapshots", "rates",
+               "alerts", ...}: the bounded telemetry time-series ring
+               (utils/timeseries.py) + the declarative alert engine's
+               verdicts; every call records one fresh sample first, so
+               two consecutive calls always yield a computable rate
+
+The same exposition is optionally served as PLAIN HTTP (``GET
+/metrics`` on ``--metrics-port``; off by default) so a stock Prometheus
+scrapes the node without speaking the custom gRPC framing.
 
 Cross-node trace context: consensus, gossip, state-sync and DAS
 requests may carry an optional ``"_tc"`` envelope field (specs/
@@ -62,7 +73,15 @@ class NodeService:
     """Method implementations over an in-process node (TestNode surface)."""
 
     def __init__(self, node, das_max_inflight: int = 4):
+        from celestia_tpu.utils import timeseries as ts_mod
+
         self.node = node
+        # continuous telemetry: the bounded snapshot ring + the alert
+        # engine (stock rules + operator-declared CELESTIA_TPU_ALERT_RULES)
+        self.timeseries = ts_mod.TimeSeries()
+        self.alert_engine = ts_mod.AlertEngine(ts_mod.default_rules())
+        for rule in ts_mod.rules_from_env():
+            self.alert_engine.add_rule(rule)
         # DAS serving-plane admission (specs/robustness.md): sampling
         # requests above the inflight bound are SHED with a retry-after
         # hint instead of queueing behind the service lock until every
@@ -289,19 +308,23 @@ class NodeService:
 
     # -- observability plane (utils/telemetry.py + utils/tracing.py) ----
 
-    def metrics(self, req: bytes, ctx) -> bytes:
-        """Prometheus text exposition of the node's telemetry: counters,
-        gauges, the bounded log2 histograms, per-span aggregates (when
-        tracing is on) and the unified cache registry.  Raw text bytes —
-        point a scraper straight at the RPC.
+    def metrics_text(self) -> str:
+        """The ONE exposition builder (the gRPC ``Metrics`` RPC and the
+        plain-HTTP ``/metrics`` endpoint both serve exactly this):
+        counters, gauges, the bounded log2 histograms, per-span
+        aggregates (when tracing is on) and the unified cache registry.
 
         Appended sections (all line-parse-valid, the same gate as the
         core export): client-side RPC counters (this node's OWN outbound
         pulls — gossip catch-up, state-sync), fault-note/degradation
         totals (the robustness ladder, so ``cluster-health`` needs no
-        second RPC), and the node identity as an info gauge."""
+        second RPC), the node identity as an info gauge, the device
+        plane's ``celestia_tpu_xla_*``/``celestia_tpu_device_*`` gauges
+        (utils/devprof.py), trace-ring health (span drops + background
+        depth — silent truncation must be remotely detectable) and the
+        alert engine's per-rule firing states."""
         from celestia_tpu.client import remote as remote_mod
-        from celestia_tpu.utils import faults
+        from celestia_tpu.utils import devprof, faults
         from celestia_tpu.utils.telemetry import escape_label_value
 
         lines = [self.node.app.telemetry.export_prometheus().rstrip("\n")]
@@ -322,7 +345,69 @@ class NodeService:
                 'celestia_tpu_node_info{node_id="%s"} 1'
                 % escape_label_value(nid)
             )
-        return ("\n".join(lines) + "\n").encode()
+        # device plane (XLA cost table, per-chip busy ms, mem watermark)
+        lines.extend(devprof.exposition_lines())
+        # trace-ring health (satellite: remote truncation detectability)
+        rs = tracing.ring_stats()
+        lines.append(
+            "# TYPE celestia_tpu_trace_span_drops_total counter"
+        )
+        lines.append(
+            f"celestia_tpu_trace_span_drops_total {rs['span_drops_total']}"
+        )
+        lines.append(
+            f"celestia_tpu_trace_background_depth {rs['background_depth']}"
+        )
+        # alert states: one 0/1 gauge per rule + the firing total, so
+        # cluster_health flags a degrading node from the scrape alone
+        firing_total = 0
+        for verdict in self.alert_engine.evaluate(self.timeseries):
+            label = escape_label_value(verdict["name"])
+            val = 1 if verdict["firing"] else 0
+            firing_total += val
+            lines.append(f'celestia_tpu_alert_firing{{rule="{label}"}} {val}')
+        lines.append(f"celestia_tpu_alerts_firing_total {firing_total}")
+        lines.append(f"celestia_tpu_timeseries_samples {len(self.timeseries)}")
+        return "\n".join(lines) + "\n"
+
+    def metrics(self, req: bytes, ctx) -> bytes:
+        """Prometheus text exposition (see :meth:`metrics_text`).  Raw
+        text bytes — point a scraper straight at the RPC."""
+        return self.metrics_text().encode()
+
+    def sample_timeseries(self) -> None:
+        """Record ONE snapshot of the node's operational signals into
+        the ring (the sampler thread's tick; also the on-demand sample
+        every TimeSeries RPC takes before answering)."""
+        from celestia_tpu.utils import faults, timeseries as ts_mod
+
+        try:
+            self.timeseries.record(ts_mod.collect_node_sample(self.node))
+        except Exception as e:
+            # a collector bug degrades the ring, never the node
+            faults.note("timeseries.sample", e)
+
+    def time_series(self, req: bytes, ctx) -> bytes:
+        """The continuous-telemetry ring + alert verdicts.  One fresh
+        sample is recorded per call, so two consecutive RPCs always
+        return >= 2 snapshots with a computable rate — a fresh node is
+        queryable immediately, no waiting on the sampler cadence."""
+        q = json.loads(req or b"{}")
+        self.sample_timeseries()
+        last = q.get("last")
+        snapshots = self.timeseries.samples(
+            int(last) if last is not None else None
+        )
+        return json.dumps(
+            {
+                "node_id": tracing.node_id(),
+                "samples_kept": len(self.timeseries),
+                "max_samples": self.timeseries.max_samples,
+                "snapshots": snapshots,
+                "rates": self.timeseries.rates(),
+                "alerts": self.alert_engine.evaluate(self.timeseries),
+            }
+        ).encode()
 
     def clock_probe(self, req: bytes, ctx) -> bytes:
         """One sanctioned telemetry-clock read for the cross-node
@@ -465,6 +550,7 @@ class NodeService:
             "Metrics": self.metrics,
             "TraceDump": self.trace_dump,
             "ClockProbe": self.clock_probe,
+            "TimeSeries": self.time_series,
             "DasSample": self.das_sample,
             "ConsPrepare": self.cons_prepare,
             "ConsProcess": self.cons_process,
@@ -516,8 +602,64 @@ class NodeService:
         return handler
 
 
+class _MetricsHTTPServer:
+    """Plain-HTTP ``/metrics`` endpoint (stdlib ``http.server`` on its
+    own daemon thread) so a stock Prometheus scrapes the node without
+    speaking the custom gRPC framing.  Serves EXACTLY
+    ``NodeService.metrics_text()`` — one exposition builder, two
+    transports.  Off by default; explicit shutdown path."""
+
+    def __init__(self, service: "NodeService", host: str, port: int):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        svc = service
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib handler contract
+                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                    self.send_error(404, "only /metrics is served")
+                    return
+                try:
+                    body = svc.metrics_text().encode()
+                except Exception as e:  # noqa: BLE001 — scraper gets a 500
+                    self.send_error(500, str(e)[:200])
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes must not spam stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.address = f"{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-http", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        # shutdown() waits on an event only serve_forever() sets: calling
+        # it on a constructed-but-never-started server would hang forever
+        # (e.g. teardown after the gRPC bind raised before start())
+        if self._thread.is_alive():
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
 class NodeServer:
-    """A running node + its gRPC service + a block-production loop."""
+    """A running node + its gRPC service + a block-production loop
+    (+ the optional plain-HTTP metrics endpoint and the continuous
+    telemetry sampler)."""
 
     def __init__(
         self,
@@ -526,6 +668,8 @@ class NodeServer:
         block_interval_s: Optional[float] = None,
         max_workers: int = 8,
         das_max_inflight: int = 4,
+        metrics_port: Optional[int] = None,
+        timeseries_interval_s: Optional[float] = 5.0,
     ):
         self.node = node
         self.service = NodeService(node, das_max_inflight=das_max_inflight)
@@ -549,6 +693,21 @@ class NodeServer:
         self.block_interval_s = block_interval_s
         self._stop = threading.Event()
         self._producer: Optional[threading.Thread] = None
+        # continuous telemetry sampler (utils/timeseries.py): one cheap
+        # snapshot per tick; None/0 disables
+        self.timeseries_interval_s = (
+            float(timeseries_interval_s)
+            if timeseries_interval_s
+            else None
+        )
+        self._sampler: Optional[threading.Thread] = None
+        # plain-HTTP /metrics (off unless a port is given; 0 = ephemeral)
+        self.metrics_http: Optional[_MetricsHTTPServer] = None
+        if metrics_port is not None:
+            host = address.rsplit(":", 1)[0] or "127.0.0.1"
+            self.metrics_http = _MetricsHTTPServer(
+                self.service, host, int(metrics_port)
+            )
         # node-internal locking: the production loop and gRPC workers touch
         # the same app state; the TestNode surface is synchronised by this
         # coarse lock installed onto the node.
@@ -575,11 +734,19 @@ class NodeServer:
 
     def start(self) -> None:
         self._server.start()
+        if self.metrics_http is not None:
+            self.metrics_http.start()
         if self.block_interval_s:
             self._producer = threading.Thread(
                 target=self._produce_loop, name="block-producer", daemon=True
             )
             self._producer.start()
+        if self.timeseries_interval_s:
+            self._sampler = threading.Thread(
+                target=self._sample_loop, name="timeseries-sampler",
+                daemon=True,
+            )
+            self._sampler.start()
 
     def _produce_loop(self) -> None:
         while not self._stop.wait(self.block_interval_s):
@@ -590,11 +757,28 @@ class NodeServer:
 
                 traceback.print_exc()
 
+    def _sample_loop(self) -> None:
+        # Event.wait paces the cadence (no sleep-in-loop, celint R5);
+        # sample_timeseries itself swallows collector bugs via
+        # faults.note, so the loop body cannot die.  The seed sample
+        # runs HERE, not in start(): the collector's device-plane read
+        # initializes the jax backend, and a dead accelerator tunnel can
+        # HANG that init for minutes — a daemon sampler may stall, node
+        # startup must not (same rationale as the CLI's child-process
+        # backend probe).
+        self.service.sample_timeseries()
+        while not self._stop.wait(self.timeseries_interval_s):
+            self.service.sample_timeseries()
+
     def stop(self, grace: float = 1.0) -> None:
         self._stop.set()
         self._server.stop(grace)
+        if self.metrics_http is not None:
+            self.metrics_http.stop()
         if self._producer is not None:
             self._producer.join(timeout=5)
+        if self._sampler is not None:
+            self._sampler.join(timeout=5)
 
     def __enter__(self) -> "NodeServer":
         self.start()

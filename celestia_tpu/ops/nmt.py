@@ -103,6 +103,31 @@ def eds_nmt_roots(eds: jnp.ndarray) -> jnp.ndarray:
     return nmt_roots(eds_prefixed_leaves(eds))
 
 
+# one jitted whole-EDS root program shared by every eager caller; the
+# race on first assignment is benign (two identical jit wrappers, one
+# survives, the XLA executable cache is shared anyway)
+_EDS_ROOTS_JIT = None
+
+
+def eds_nmt_roots_device(eds) -> np.ndarray:
+    """Jitted, devprof-instrumented DEVICE entry for the whole-EDS root
+    pass: uint8[2k,2k,B] (host or device) -> uint8[2, 2k, 90] on the
+    host.  The eager :func:`eds_nmt_roots` stays the traceable form for
+    fused callers; this wrapper is the standalone dispatch
+    (da/dah.new_data_availability_header's jax leg), bracketed with
+    device timing + XLA cost accounting (utils/devprof.py)."""
+    global _EDS_ROOTS_JIT
+    from celestia_tpu.utils import devprof
+
+    if _EDS_ROOTS_JIT is None:
+        _EDS_ROOTS_JIT = jax.jit(eds_nmt_roots)
+    arr = jnp.asarray(eds)
+    d = devprof.dispatch("eds_nmt_roots", n2=int(arr.shape[0]))
+    out = d.done(_EDS_ROOTS_JIT(arr))
+    devprof.note_compile("eds_nmt_roots", _EDS_ROOTS_JIT, (arr,))
+    return np.asarray(out)
+
+
 def _nmt_roots_np_batch(leaves: np.ndarray) -> np.ndarray:
     """Host reduction of a batch of NMTs: uint8[T, n, L] -> uint8[T, 90].
 

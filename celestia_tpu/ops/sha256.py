@@ -131,9 +131,20 @@ def _sha256_jit(ndim: int):
 
 
 def sha256_np(msgs: np.ndarray) -> np.ndarray:
-    """Convenience host entry: numpy in/out, jitted per input rank."""
+    """Convenience host entry: numpy in/out, jitted per input rank.
+
+    As a standalone device dispatch it carries the devprof bracket
+    (device-track timing + XLA cost accounting) — disabled, the bracket
+    is one call returning a shared no-op."""
+    from celestia_tpu.utils import devprof
+
     msgs = np.asarray(msgs, dtype=np.uint8)
-    return np.asarray(_sha256_jit(msgs.ndim)(jnp.asarray(msgs)))
+    fn = _sha256_jit(msgs.ndim)
+    arr = jnp.asarray(msgs)
+    d = devprof.dispatch("sha256_batch", msg_len=int(msgs.shape[-1]))
+    out = d.done(fn(arr))
+    devprof.note_compile("sha256_batch", fn, (arr,))
+    return np.asarray(out)
 
 
 def sha256_batch_host(msgs: np.ndarray, nthreads=None) -> np.ndarray:

@@ -275,6 +275,11 @@ class Tracer:
         # per-name duration aggregation (bounded histograms) feeding the
         # telemetry summary; celint: guarded-by(self._lock)
         self._agg: Dict[str, Log2Histogram] = {}
+        # cumulative span/instant drops across ALL traces (per-trace
+        # ``dropped`` dies with its ring slot; a busy node silently
+        # truncating must be detectable remotely long after);
+        # celint: guarded-by(self._lock)
+        self._span_drops_total = 0
         self.enabled = False
 
     # -- lifecycle -----------------------------------------------------
@@ -298,6 +303,7 @@ class Tracer:
             self._blocks.clear()
             self._background.clear()
             self._agg.clear()
+            self._span_drops_total = 0
 
     @property
     def max_blocks(self) -> int:
@@ -419,6 +425,7 @@ class Tracer:
                     sink.instants.append(ev)
                 else:
                     sink.dropped += 1
+                    self._span_drops_total += 1
             else:
                 self._background.append(ev)
 
@@ -443,6 +450,7 @@ class Tracer:
                     sink.spans.append(s)
                 else:
                     sink.dropped += 1
+                    self._span_drops_total += 1
                 if is_root:
                     sink.complete = True
                     self._blocks.append(sink)
@@ -457,6 +465,19 @@ class Tracer:
         if last is not None:
             traces = traces[-max(0, int(last)):]
         return traces
+
+    def ring_stats(self) -> dict:
+        """Ring-health counters for the metrics plane (satellite: silent
+        trace truncation on a busy node must be detectable REMOTELY, not
+        only in a local dump): cumulative span/instant drops, the
+        background-ring depth, and the block-ring fill."""
+        with self._lock:
+            return {
+                "span_drops_total": self._span_drops_total,
+                "background_depth": len(self._background),
+                "blocks_kept": len(self._blocks),
+                "max_blocks": self._blocks.maxlen or DEFAULT_MAX_BLOCKS,
+            }
 
     def span_summary(self) -> Dict[str, dict]:
         """Per-span-name duration aggregates (count/p50/p95/p99/max) for
@@ -672,6 +693,10 @@ def trace_dump(last: Optional[int] = None) -> dict:
 
 def span_summary() -> Dict[str, dict]:
     return TRACER.span_summary()
+
+
+def ring_stats() -> dict:
+    return TRACER.ring_stats()
 
 
 def block_traces(last: Optional[int] = None) -> List[BlockTrace]:

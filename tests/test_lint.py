@@ -308,6 +308,10 @@ def test_r3_sanctioned_channels_may_read_clocks():
 
     assert "celestia_tpu/utils/tracing.py" in SANCTIONED_CHANNELS
     assert "celestia_tpu/utils/telemetry.py" in SANCTIONED_CHANNELS
+    # PR 11: the device half + the continuous-telemetry ring read the
+    # clock through the same door and carry the same entropy bans
+    assert "celestia_tpu/utils/devprof.py" in SANCTIONED_CHANNELS
+    assert "celestia_tpu/utils/timeseries.py" in SANCTIONED_CHANNELS
     for rel in SANCTIONED_CHANNELS:
         assert _ids(_lint(R3_CHANNEL_CLOCK_OK, rel)) == [], rel
 

@@ -1,0 +1,229 @@
+"""Continuous telemetry (utils/timeseries.py): the bounded snapshot
+ring, rate/derivative queries, the declarative alert engine
+(value/sustained-burn, rate, stall), operator rules from the
+environment, and the node snapshot collector."""
+
+import json
+
+import pytest
+
+from celestia_tpu.utils import timeseries as ts_mod
+from celestia_tpu.utils.timeseries import AlertEngine, AlertRule, TimeSeries
+
+
+def _series(points, metric="x"):
+    """TimeSeries from [(ts, value), ...] with controlled timestamps."""
+    s = TimeSeries(64)
+    for ts, v in points:
+        s.record({metric: v}, ts=ts)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# the ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_is_bounded_and_ordered():
+    s = TimeSeries(4)
+    for i in range(10):
+        s.record({"x": i}, ts=float(i))
+    snaps = s.samples()
+    assert len(snaps) == 4 == len(s)
+    assert [sn["values"]["x"] for sn in snaps] == [6.0, 7.0, 8.0, 9.0]
+    assert s.samples(last=2)[-1]["values"]["x"] == 9.0
+
+
+def test_non_numeric_values_dropped():
+    s = TimeSeries(4)
+    s.record({"x": 1, "bad": "string", "worse": None, "b": True}, ts=1.0)
+    assert s.samples()[0]["values"] == {"x": 1.0}
+
+
+def test_rate_delta_latest():
+    s = _series([(100.0, 10.0), (110.0, 15.0), (120.0, 30.0)])
+    assert s.latest("x") == 30.0
+    assert s.delta("x") == 20.0
+    assert s.rate("x") == pytest.approx(1.0)  # 20 over 20 s
+    # windowed: only the last 10 s
+    assert s.rate("x", window_s=10.0) == pytest.approx(1.5)
+    assert s.rate("missing") is None
+    assert s.delta("x", window_s=0.5) is None  # one point in window
+    assert s.rates()["x"] == pytest.approx(1.0)
+
+
+def test_rate_zero_dt_is_none():
+    s = _series([(100.0, 1.0), (100.0, 2.0)])
+    assert s.rate("x") is None
+
+
+# ---------------------------------------------------------------------------
+# alert rules
+# ---------------------------------------------------------------------------
+
+
+def test_value_rule_sustained_burn():
+    rule = AlertRule("hot", metric="x", op=">", threshold=5.0, for_s=10.0)
+    # breached, but only for 5 s: NOT firing (single-scrape noise)
+    s = _series([(100.0, 1.0), (105.0, 9.0), (110.0, 9.0)])
+    v = rule.evaluate(s)
+    assert not v["firing"] and v["held_s"] == 5.0
+    # breached for the full window: firing
+    s = _series([(100.0, 9.0), (105.0, 9.0), (111.0, 9.0)])
+    v = rule.evaluate(s)
+    assert v["firing"] and v["held_s"] == 11.0
+    # a healthy sample inside the run resets the burn clock
+    s = _series([(100.0, 9.0), (105.0, 1.0), (111.0, 9.0)])
+    assert not rule.evaluate(s)["firing"]
+
+
+def test_value_rule_for_zero_is_latest_sample():
+    rule = AlertRule("now", metric="x", op="<", threshold=0.5, for_s=0.0)
+    assert rule.evaluate(_series([(1.0, 0.1)]))["firing"]
+    assert not rule.evaluate(_series([(1.0, 0.9)]))["firing"]
+
+
+def test_rule_skips_absent_metric():
+    # a CPU node never carries device_mem_peak_frac: the rule must stay
+    # silent, not fire on a phantom zero
+    rule = AlertRule("mem", metric="device_mem_peak_frac", op=">", threshold=0.9)
+    v = rule.evaluate(_series([(1.0, 1.0)], metric="other"))
+    assert not v["firing"] and v["value"] is None
+
+
+def test_rate_rule():
+    rule = AlertRule(
+        "leak", metric="bytes", op=">", threshold=1.0, kind="rate"
+    )
+    s = _series([(100.0, 0.0), (110.0, 100.0)], metric="bytes")
+    v = rule.evaluate(s)
+    assert v["firing"] and v["value"] == pytest.approx(10.0)
+    s = _series([(100.0, 0.0), (110.0, 5.0)], metric="bytes")
+    assert not rule.evaluate(s)["firing"]
+
+
+def test_stall_rule():
+    rule = AlertRule("stall", metric="h", kind="stall", for_s=10.0)
+    # moving: not firing
+    s = _series([(100.0, 1.0), (106.0, 2.0), (112.0, 3.0)], metric="h")
+    assert not rule.evaluate(s)["firing"]
+    # flat for 12 s: firing
+    s = _series([(100.0, 3.0), (106.0, 3.0), (112.0, 3.0)], metric="h")
+    v = rule.evaluate(s)
+    assert v["firing"] and v["held_s"] == 12.0
+    # flat only for the trailing 6 s: not yet
+    s = _series([(100.0, 2.0), (106.0, 3.0), (112.0, 3.0)], metric="h")
+    assert not rule.evaluate(s)["firing"]
+    # one sample can never prove a stall
+    assert not rule.evaluate(_series([(100.0, 3.0)], metric="h"))["firing"]
+
+
+def test_rule_validation():
+    with pytest.raises(ValueError):
+        AlertRule("x", metric="m", kind="bogus")
+    with pytest.raises(ValueError):
+        AlertRule("x", metric="m", op="!=")
+
+
+def test_engine_and_default_rules_quiet_on_empty():
+    engine = AlertEngine(ts_mod.default_rules())
+    assert engine.firing(TimeSeries(4)) == []
+    rules = {r.name: r for r in engine.rules()}
+    assert {
+        "eds_cache_hit_rate_floor", "breakers_open",
+        "device_mem_watermark", "height_stall", "degradations",
+    } <= set(rules)
+    # the memory rule keys on CURRENT usage, never the lifetime peak
+    # (peak_frac is monotone: a rule on it would latch forever)
+    assert rules["device_mem_watermark"].metric == "device_mem_frac"
+    assert rules["device_mem_watermark"].for_s > 0
+
+
+def test_rules_from_json_schema_errors():
+    with pytest.raises(ValueError):
+        ts_mod.rules_from_json("not json")
+    with pytest.raises(ValueError):
+        ts_mod.rules_from_json('{"name": "not-a-list"}')
+    with pytest.raises(ValueError):
+        ts_mod.rules_from_json('[{"name": "x"}]')  # no metric
+    with pytest.raises(ValueError):
+        ts_mod.rules_from_json('[{"name": "x", "metric": "m", "bogus": 1}]')
+    rules = ts_mod.rules_from_json(
+        '[{"name": "x", "metric": "m", "op": "<", "threshold": 2, '
+        '"for_s": 3, "severity": "critical"}]'
+    )
+    assert rules[0].threshold == 2.0 and rules[0].severity == "critical"
+
+
+def test_rules_from_env(monkeypatch):
+    monkeypatch.delenv(ts_mod.ENV_RULES, raising=False)
+    assert ts_mod.rules_from_env() == []
+    monkeypatch.setenv(
+        ts_mod.ENV_RULES,
+        json.dumps([{"name": "smoke", "metric": "height", "kind": "stall"}]),
+    )
+    rules = ts_mod.rules_from_env()
+    assert len(rules) == 1 and rules[0].kind == "stall"
+
+
+# ---------------------------------------------------------------------------
+# the node collector
+# ---------------------------------------------------------------------------
+
+
+class _FakeNode:
+    height = 42
+    app = None
+    gossip_engine = None
+
+
+def test_collect_node_sample_minimal_node():
+    from celestia_tpu.utils import devprof
+
+    devprof.reset()  # a fresh probe history: first sample has no delta
+    values = ts_mod.collect_node_sample(_FakeNode())
+    assert values["height"] == 42.0
+    # always-present process-wide signals
+    for key in (
+        "degradations", "fault_notes", "trace_span_drops",
+        "trace_background_depth", "cache_total_bytes",
+    ):
+        assert key in values, key
+    # UNMEASURED metrics are OMITTED, not zeroed: no telemetry on the
+    # fake node, and with the devprof bracket disarmed a hard 0.0 for
+    # busy/occupancy would read as "device idle" while it may be loaded
+    assert "das_shed" not in values
+    assert "device_busy_ms_total" not in values
+    assert "device_occupancy_pct" not in values
+    # armed (a collect window), the device metrics appear — occupancy
+    # from the SECOND probe on (inter-probe delta)
+    with devprof.collect():
+        v1 = ts_mod.collect_node_sample(_FakeNode())
+        assert "device_busy_ms_total" in v1
+        assert "device_occupancy_pct" not in v1
+        v2 = ts_mod.collect_node_sample(_FakeNode())
+        assert 0.0 <= v2["device_occupancy_pct"] <= 100.0
+    # everything numeric: the ring's record() would keep all of it
+    assert all(isinstance(v, float) for v in values.values())
+
+
+def test_degradation_trips_stock_rule():
+    """The profile-smoke shape in miniature: a recorded degradation
+    flows collector -> ring -> the stock `degradations` rule."""
+    from celestia_tpu.utils import faults
+
+    base = len(faults.fault_stats()["degradations"])
+    series = TimeSeries(8)
+    series.record(ts_mod.collect_node_sample(_FakeNode()))
+    rule = AlertRule(
+        "degradations_above_base", metric="degradations",
+        op=">", threshold=float(base), for_s=0.0,
+    )
+    assert not rule.evaluate(series)["firing"]
+    try:
+        faults.record_degradation("test_timeseries", "synthetic degradation")
+        series.record(ts_mod.collect_node_sample(_FakeNode()))
+        assert rule.evaluate(series)["firing"]
+    finally:
+        # the degradation log is process-wide; leave it as found
+        faults.reset_stats()

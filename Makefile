@@ -3,12 +3,23 @@
 # target hardens the native pipeline whenever the toolchain allows.
 
 PY ?= python
+# machine-readable lint output: `make lint LINT_FORMAT=json` (or sarif)
+# passes --format through; exit codes are unchanged either way
+LINT_FORMAT ?=
 
-.PHONY: lint test chaos trace-smoke profile-smoke bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
+.PHONY: lint lockwatch test chaos trace-smoke profile-smoke bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
 
 ## celint: concurrency & determinism static analysis (exit 1 on findings)
 lint:
-	$(PY) -m celestia_tpu.lint
+	$(PY) -m celestia_tpu.lint $(if $(LINT_FORMAT),--format $(LINT_FORMAT))
+
+## lock-order shadow checker over the tier-1 concurrency hammers: the
+## runtime half of celint R6.  CELESTIA_TPU_LOCKWATCH=1 installs the
+## watched-lock factories before any module lock is constructed; the
+## session FAILS on any observed lock-order inversion (both acquisition
+## stacks printed via the conftest gate)
+lockwatch:
+	CELESTIA_TPU_LOCKWATCH=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_lockwatch.py tests/test_race.py tests/test_lru.py -q -m 'not slow' -p no:cacheprovider
 
 ## tier-1 test suite (same selection the CI driver runs)
 test:

@@ -758,6 +758,28 @@ def _fault_stats_extras() -> dict:
     return out
 
 
+def _lint_stats_extras() -> dict:
+    """extras.lint_stats: one full-tree celint run with per-rule wall
+    timing — the whole-program pass (R6 builds a cross-module lock graph)
+    is a growing cost that bench_check watches for drift the same way it
+    watches latency legs."""
+    from celestia_tpu.lint import LintStats, failing, run_lint
+
+    stats = LintStats()
+    findings = run_lint(stats=stats)
+    d = stats.to_dict()
+    return {
+        "wall_ms": d["total_wall_ms"],
+        "files": d["files"],
+        "failing": len(failing(findings)),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "rules": {
+            rid: {"wall_ms": rec["wall_ms"], "findings": rec["findings"]}
+            for rid, rec in d["rules"].items()
+        },
+    }
+
+
 def _host_repair_ms(k: int):
     """Host-only repair (the light-client/DAS path — no accelerator):
     25% withheld, root-verified.  Under the leopard codec this runs the
@@ -917,6 +939,12 @@ def _host_only_main():
         extras["unified_caches"] = _unified_cache_stats()
     except Exception as e:
         extras["unified_caches_error"] = repr(e)[:200]
+    try:
+        # static-analysis cost trajectory: celint whole-tree wall ms +
+        # per-rule split (bench_check watches lint_stats.wall_ms)
+        extras["lint_stats"] = _lint_stats_extras()
+    except Exception as e:
+        extras["lint_stats_error"] = repr(e)[:200]
     leg = extras.get("cpu_leg", "table_gf_cpu")
     print(
         json.dumps(
@@ -1082,6 +1110,12 @@ def main():
         extras["unified_caches"] = _unified_cache_stats()
     except Exception as e:
         extras["unified_caches_error"] = repr(e)[:200]
+    try:
+        # static-analysis cost trajectory: celint whole-tree wall ms +
+        # per-rule split (bench_check watches lint_stats.wall_ms)
+        extras["lint_stats"] = _lint_stats_extras()
+    except Exception as e:
+        extras["lint_stats_error"] = repr(e)[:200]
 
     vs = round(cpu_ms / device_ms, 1) if cpu_ms else 0.0
     print(

@@ -1,0 +1,15 @@
+"""celestia_tpu package root.
+
+Kept import-light: one environment check arms the lock-order shadow
+checker (utils/lockwatch.py) BEFORE any submodule constructs its
+module-level locks — the watcher can only wrap locks whose construction
+it precedes.  Without ``CELESTIA_TPU_LOCKWATCH`` in the environment this
+file does nothing.
+"""
+
+import os as _os
+
+if _os.environ.get("CELESTIA_TPU_LOCKWATCH", "").strip():
+    from celestia_tpu.utils import lockwatch as _lockwatch
+
+    _lockwatch.install_from_env()

@@ -17,6 +17,13 @@ NAMESPACE_ID_SIZE = 28
 NAMESPACE_SIZE = NAMESPACE_VERSION_SIZE + NAMESPACE_ID_SIZE  # 29
 NAMESPACE_VERSION_MAX = 255
 
+# Raw bytes of the parity-share namespace (version 0xFF, id all-0xFF —
+# global_consts.go:68-75).  da/namespace.py wraps these in its Namespace
+# type; the bytes themselves live HERE because ops/nmt.py prefixes every
+# Q1-Q3 leaf with them and ops/ sits below da/ in the package DAG
+# (celint R8: ops may not import da).
+PARITY_SHARE_NAMESPACE_RAW = b"\xff" * NAMESPACE_SIZE
+
 # --- Share layout (global_consts.go:29-66) ---
 SHARE_SIZE = 512
 SHARE_INFO_BYTES = 1

@@ -1227,7 +1227,7 @@ def cmd_validate_genesis(args) -> int:
 def cmd_txsim(args) -> int:
     """Load generator against a running node (test/cmd/txsim parity)."""
     from celestia_tpu.client.signer import Signer
-    from celestia_tpu.node import txsim
+    from celestia_tpu.client import txsim
 
     node = _remote(args)
     master = Signer(node, _load_key(_home(args), getattr(args, "from_key")))

@@ -15,27 +15,26 @@ nonce-mismatch recovery and re-signing (:268-309), ConfirmTx polling
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 from celestia_tpu.client import errors as client_errors
 from celestia_tpu.da.blob import Blob, BlobTx
 from celestia_tpu.da.inclusion import create_commitment
 from celestia_tpu.state.modules.blob import estimate_gas
-from celestia_tpu.state.tx import Fee, Msg, MsgPayForBlobs, Tx
+# SubmitResult moved to state/tx.py (celint R8: the node tier produces
+# it); re-exported here so client-side callers are unchanged
+from celestia_tpu.state.tx import (  # noqa: F401
+    Fee,
+    Msg,
+    MsgPayForBlobs,
+    SubmitResult,
+    Tx,
+)
 from celestia_tpu.utils.secp256k1 import PrivateKey
 
 DEFAULT_GAS_LIMIT = 210_000
 DEFAULT_POLL_INTERVAL_S = 0.05
 DEFAULT_CONFIRM_TIMEOUT_S = 30.0
-
-
-@dataclass
-class SubmitResult:
-    code: int
-    log: str
-    tx_hash: bytes
-    height: Optional[int] = None
 
 
 class Signer:

@@ -16,6 +16,7 @@ from celestia_tpu.appconsts import (
     NAMESPACE_SIZE,
     NAMESPACE_VERSION_MAX,
     NAMESPACE_VERSION_SIZE,
+    PARITY_SHARE_NAMESPACE_RAW,
 )
 
 # Version-0 namespaces must have 18 leading zero bytes in the 28-byte ID,
@@ -121,4 +122,6 @@ PRIMARY_RESERVED_PADDING_NAMESPACE = _primary(0xFF)
 MAX_PRIMARY_RESERVED_NAMESPACE = _primary(0xFF)
 MIN_SECONDARY_RESERVED_NAMESPACE = _secondary(0x00)
 TAIL_PADDING_NAMESPACE = _secondary(0xFE)
-PARITY_SHARE_NAMESPACE = _secondary(0xFF)
+# the raw bytes are pinned in appconsts (ops/nmt.py consumes them below
+# the da/ layer); wrapping them here keeps one source of truth
+PARITY_SHARE_NAMESPACE = Namespace(PARITY_SHARE_NAMESPACE_RAW)

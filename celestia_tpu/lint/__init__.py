@@ -8,14 +8,19 @@ machinery, rules.py for R1-R4, specs/static_analysis.md for the docs.
 from celestia_tpu.lint.engine import (  # noqa: F401
     ALIASES,
     Finding,
+    LintStats,
     ModuleContext,
+    Program,
+    ProgramRule,
     REGISTRY,
     Rule,
     failing,
+    lint_program,
     lint_source,
     register,
     render_human,
     render_json,
+    render_sarif,
     resolve_rules,
     run_lint,
 )

@@ -33,6 +33,9 @@ merely LOOK like directives — e.g. lint test fixtures — never register):
 Adding a rule: subclass :class:`Rule`, set ``id``/``summary``/``doc``,
 implement ``check(ctx)`` yielding :class:`Finding`, and decorate with
 ``@register``.  Import it from ``rules.py`` so the registry sees it.
+Whole-program rules (lock-order needs every module's acquisition graph
+at once) subclass :class:`ProgramRule` instead and implement
+``check_program(program)`` over the :class:`Program` context.
 See specs/static_analysis.md for the catalog and worked examples.
 """
 
@@ -42,6 +45,7 @@ import ast
 import io
 import json
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -245,6 +249,30 @@ class Rule:
         raise NotImplementedError
 
 
+class Program:
+    """Whole-program context: every module's :class:`ModuleContext` plus
+    run-scope facts program rules need (whether this run covers the
+    default full package, so drift checks only fire on complete views)."""
+
+    def __init__(self, contexts: List[ModuleContext], full_tree: bool = False):
+        self.contexts = contexts
+        self.by_path: Dict[str, ModuleContext] = {
+            c.relpath: c for c in contexts
+        }
+        self.full_tree = full_tree
+
+
+class ProgramRule(Rule):
+    """A rule that needs every module at once (cross-module lock order).
+    ``check`` is never called; the runner calls ``check_program``."""
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        return iter(())
+
+    def check_program(self, program: Program) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
 REGISTRY: Dict[str, Rule] = {}
 
 # short aliases accepted by --rules (ISSUE numbering)
@@ -254,6 +282,9 @@ ALIASES = {
     "r3": "consensus-determinism",
     "r4": "hostpool-discipline",
     "r5": "sanctioned-retry",
+    "r6": "lock-order",
+    "r7": "host-sync",
+    "r8": "layering",
 }
 
 
@@ -286,42 +317,122 @@ def resolve_rules(names: Optional[Iterable[str]]) -> List[Rule]:
 # -- runner ------------------------------------------------------------
 
 
+class LintStats:
+    """Per-rule wall time + finding counts for ``--stats`` and bench's
+    ``extras.lint_stats`` — the whole-program pass must stay a watched
+    number, not a silently growing tax on tier-1."""
+
+    def __init__(self):
+        self.rules: Dict[str, dict] = {}
+        self.files = 0
+        self.total_wall_ms = 0.0
+
+    def add(self, rule_id: str, wall_s: float) -> None:
+        rec = self.rules.setdefault(
+            rule_id, {"wall_ms": 0.0, "findings": 0, "suppressed": 0}
+        )
+        rec["wall_ms"] += wall_s * 1000.0
+
+    def count(self, findings: Iterable[Finding]) -> None:
+        for f in findings:
+            rec = self.rules.setdefault(
+                f.rule, {"wall_ms": 0.0, "findings": 0, "suppressed": 0}
+            )
+            if f.suppressed:
+                rec["suppressed"] += 1
+            else:
+                rec["findings"] += 1
+
+    def to_dict(self) -> dict:
+        return {
+            "files": self.files,
+            "total_wall_ms": round(self.total_wall_ms, 3),
+            "rules": {
+                rid: {
+                    "wall_ms": round(rec["wall_ms"], 3),
+                    "findings": rec["findings"],
+                    "suppressed": rec["suppressed"],
+                }
+                for rid, rec in sorted(self.rules.items())
+            },
+        }
+
+
+def _mark_allow(ctx: Optional[ModuleContext], f: Finding) -> Finding:
+    if ctx is not None:
+        allow = ctx.allow_for(f.rule, f.line)
+        if allow is not None:
+            allow.used = True
+            f.suppressed = True
+            f.suppress_reason = allow.reason
+    return f
+
+
+def lint_program(
+    sources: Dict[str, str],
+    rules: Optional[Iterable[str]] = None,
+    *,
+    full_tree: bool = False,
+    stats: Optional[LintStats] = None,
+) -> List[Finding]:
+    """Lint a set of ``{relpath: source}`` modules as ONE program:
+    per-module rules see each file, program rules (lock-order) see the
+    whole set.  The entry point for both the CLI and the cross-module
+    test fixtures."""
+    t_start = time.perf_counter()
+    active = resolve_rules(rules)
+    findings: List[Finding] = []
+    contexts: List[ModuleContext] = []
+    for relpath, source in sorted(sources.items()):
+        try:
+            ctx = ModuleContext(relpath, source)
+        except SyntaxError as e:
+            findings.append(
+                Finding(
+                    PARSE_ERROR, relpath, e.lineno or 0, e.offset or 0,
+                    f"syntax error: {e.msg}",
+                )
+            )
+            continue
+        contexts.append(ctx)
+        findings.extend(ctx.directive_errors)
+    program = Program(contexts, full_tree=full_tree)
+    enabled = {r.id for r in active}
+    for rule in active:
+        t0 = time.perf_counter()
+        if isinstance(rule, ProgramRule):
+            for f in rule.check_program(program):
+                findings.append(_mark_allow(program.by_path.get(f.path), f))
+        else:
+            for ctx in contexts:
+                for f in rule.check(ctx):
+                    findings.append(_mark_allow(ctx, f))
+        if stats is not None:
+            stats.add(rule.id, time.perf_counter() - t0)
+    for ctx in contexts:
+        for d in ctx.allows:
+            if not d.used and any(r in enabled for r in d.rules):
+                findings.append(
+                    Finding(
+                        UNUSED_SUPPRESSION, ctx.relpath, d.line, 0,
+                        f"allow({', '.join(d.rules)}) suppresses nothing — "
+                        "remove it or re-justify it",
+                    )
+                )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if stats is not None:
+        stats.files = len(sources)
+        stats.total_wall_ms = (time.perf_counter() - t_start) * 1000.0
+        stats.count(findings)
+    return findings
+
+
 def lint_source(
     source: str, relpath: str, rules: Optional[Iterable[str]] = None
 ) -> List[Finding]:
     """Lint one source text as if it lived at ``relpath`` (repo-relative,
-    forward slashes).  The entry point the self-test fixtures use."""
-    active = resolve_rules(rules)
-    try:
-        ctx = ModuleContext(relpath, source)
-    except SyntaxError as e:
-        return [
-            Finding(
-                PARSE_ERROR, relpath, e.lineno or 0, e.offset or 0,
-                f"syntax error: {e.msg}",
-            )
-        ]
-    findings: List[Finding] = list(ctx.directive_errors)
-    enabled = {r.id for r in active}
-    for rule in active:
-        for f in rule.check(ctx):
-            allow = ctx.allow_for(f.rule, f.line)
-            if allow is not None:
-                allow.used = True
-                f.suppressed = True
-                f.suppress_reason = allow.reason
-            findings.append(f)
-    for d in ctx.allows:
-        if not d.used and any(r in enabled for r in d.rules):
-            findings.append(
-                Finding(
-                    UNUSED_SUPPRESSION, relpath, d.line, 0,
-                    f"allow({', '.join(d.rules)}) suppresses nothing — "
-                    "remove it or re-justify it",
-                )
-            )
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings
+    forward slashes).  The entry point the single-module fixtures use."""
+    return lint_program({relpath: source}, rules)
 
 
 def iter_py_files(paths: Iterable[Path]) -> Iterator[Path]:
@@ -339,20 +450,22 @@ def iter_py_files(paths: Iterable[Path]) -> Iterator[Path]:
 def run_lint(
     paths: Optional[Iterable[Path]] = None,
     rules: Optional[Iterable[str]] = None,
+    *,
+    stats: Optional[LintStats] = None,
 ) -> List[Finding]:
-    """Lint files/directories (default: the celestia_tpu package)."""
+    """Lint files/directories (default: the celestia_tpu package, which
+    is the only run shape the whole-program drift checks fire on)."""
+    full_tree = paths is None
     if paths is None:
         paths = [REPO_ROOT / "celestia_tpu"]
-    findings: List[Finding] = []
+    sources: Dict[str, str] = {}
     for path in iter_py_files(paths):
         try:
             rel = str(path.resolve().relative_to(REPO_ROOT))
         except ValueError:
             rel = str(path)
-        rel = rel.replace("\\", "/")
-        source = path.read_text()
-        findings.extend(lint_source(source, rel, rules))
-    return findings
+        sources[rel.replace("\\", "/")] = path.read_text()
+    return lint_program(sources, rules, full_tree=full_tree, stats=stats)
 
 
 def failing(findings: Iterable[Finding]) -> List[Finding]:
@@ -373,12 +486,84 @@ def render_human(findings: List[Finding], show_suppressed: bool = False) -> str:
     return "\n".join(lines)
 
 
-def render_json(findings: List[Finding]) -> str:
+def render_json(
+    findings: List[Finding], stats: Optional[LintStats] = None
+) -> str:
+    doc = {
+        "findings": [f.to_dict() for f in findings],
+        "failing": len(failing(findings)),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+    }
+    if stats is not None:
+        doc["stats"] = stats.to_dict()
+    return json.dumps(doc, indent=2)
+
+
+def render_sarif(findings: List[Finding]) -> str:
+    """SARIF 2.1.0 — the machine-readable format CI annotators ingest.
+    Rule ids are the stable celint ids; suppressed findings carry a
+    ``suppressions`` entry (state ``accepted``) instead of vanishing, so
+    an auditor sees the allow AND its reason in the same document."""
+    rule_ids = sorted({f.rule for f in findings})
+    import celestia_tpu.lint.rules  # noqa: F401 — populate REGISTRY
+
+    known = dict(REGISTRY)
+    sarif_rules = []
+    for rid in rule_ids:
+        rule = known.get(rid)
+        desc = rule.summary if rule is not None else rid
+        sarif_rules.append(
+            {
+                "id": rid,
+                "shortDescription": {"text": desc},
+            }
+        )
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "warning" if f.suppressed else "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {
+                            "startLine": max(1, f.line),
+                            "startColumn": max(1, f.col + 1),
+                        },
+                    }
+                }
+            ],
+        }
+        if f.suppressed:
+            result["suppressions"] = [
+                {
+                    "kind": "inSource",
+                    "status": "accepted",
+                    "justification": f.suppress_reason,
+                }
+            ]
+        results.append(result)
     return json.dumps(
         {
-            "findings": [f.to_dict() for f in findings],
-            "failing": len(failing(findings)),
-            "suppressed": sum(1 for f in findings if f.suppressed),
+            "$schema": (
+                "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json"
+            ),
+            "version": "2.1.0",
+            "runs": [
+                {
+                    "tool": {
+                        "driver": {
+                            "name": "celint",
+                            "informationUri": "specs/static_analysis.md",
+                            "rules": sarif_rules,
+                        }
+                    },
+                    "results": results,
+                }
+            ],
         },
         indent=2,
     )

@@ -591,3 +591,13 @@ def _is_sleep_call(node: ast.Call, sleep_names: Set[str]) -> bool:
     # non-time object with a .sleep() method would be novel enough in
     # this tree to deserve the allow it would need
     return isinstance(f, ast.Attribute) and f.attr == "sleep"
+
+
+# ---------------------------------------------------------------------------
+# R6/R7/R8 live in their own modules (lock-order is a whole-program
+# pass; host-sync/layering are the hot-path and architecture rules) —
+# imported here so the registry sees them whenever the catalog loads.
+# ---------------------------------------------------------------------------
+
+from celestia_tpu.lint import hotpath as _hotpath  # noqa: E402,F401
+from celestia_tpu.lint import lockorder as _lockorder  # noqa: E402,F401

@@ -30,81 +30,30 @@ engine reports every such double-sign it observes via on_equivocation.
 from __future__ import annotations
 
 import hashlib
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from celestia_tpu.utils.secp256k1 import PrivateKey, PublicKey
 
-NIL = b""  # block_id of a nil vote
-
-PREVOTE = "prevote"
-PRECOMMIT = "precommit"
+# The wire/crypto primitives (NIL, PREVOTE/PRECOMMIT, _varint,
+# block_id_of, vote_sign_bytes, proposal_sign_bytes, Vote) moved to
+# state/consensus.py so the IBC light client and the persistence layer
+# can use them WITHOUT importing node/ (celint R8); re-exported here so
+# engine-side callers are unchanged.
+from celestia_tpu.state.consensus import (  # noqa: F401
+    NIL,
+    PRECOMMIT,
+    PREVOTE,
+    Vote,
+    _varint,
+    block_id_of,
+    proposal_sign_bytes,
+    vote_sign_bytes,
+)
 
 STEP_PROPOSE = "propose"
 STEP_PREVOTE = "prevote"
 STEP_PRECOMMIT = "precommit"
-
-
-def _varint(n: int) -> bytes:
-    if n < 0:
-        # a negative int never terminates the shift loop below; every
-        # wire decoder range-checks before reaching here, this is the
-        # last line of defense against a hang
-        raise ValueError(f"varint of negative int {n}")
-    out = bytearray()
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        if n:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
-
-
-def block_id_of(
-    height: int,
-    time_ns: int,
-    square_size: int,
-    data_root: bytes,
-    proposer: bytes,
-    last_commit_digest: bytes,
-    prev_app_hash: bytes = b"",
-) -> bytes:
-    """The consensus block id: commits to EVERY field that feeds
-    finalization — height, timestamp, layout, the data root (which
-    commits to every tx byte via the DAH), the proposer, the previous
-    block's commit certificate (LastCommitInfo feeds distribution and
-    slashing, so replicas must agree on it byte-for-byte) and the app
-    hash the previous block produced (Tendermint's header.AppHash: this
-    is what lets a commit certificate double as a LIGHT-CLIENT proof of
-    the chain's state root, the ibc 07-tendermint role)."""
-    return hashlib.sha256(
-        b"block-id" + _varint(height) + _varint(time_ns)
-        + _varint(square_size) + data_root + proposer + last_commit_digest
-        + prev_app_hash
-    ).digest()
-
-
-def vote_sign_bytes(
-    chain_id: str, height: int, round_: int, vtype: str, block_id: bytes
-) -> bytes:
-    """Round- and type-scoped vote digest.  Signing two DIFFERENT block
-    ids at one (height, round, type) is equivocation; re-voting across
-    rounds is legitimate Tendermint behavior and hashes differently."""
-    return hashlib.sha256(
-        b"bft-vote" + vtype.encode() + b"|" + chain_id.encode()
-        + _varint(height) + _varint(round_) + block_id
-    ).digest()
-
-
-def proposal_sign_bytes(
-    chain_id: str, height: int, round_: int, pol_round: int, block_id: bytes
-) -> bytes:
-    return hashlib.sha256(
-        b"bft-proposal|" + chain_id.encode() + _varint(height)
-        + _varint(round_) + _varint(pol_round + 1) + block_id
-    ).digest()
 
 
 @dataclass(frozen=True)
@@ -231,43 +180,6 @@ class Proposal:
             pol_round=pol_round,
             payload=BlockPayload.from_wire(d["payload"]),
             proposer=bytes.fromhex(d["proposer"]),
-            signature=bytes.fromhex(d["signature"]),
-        )
-
-
-@dataclass(frozen=True)
-class Vote:
-    vtype: str  # PREVOTE / PRECOMMIT
-    height: int
-    round: int
-    block_id: bytes  # NIL for a nil vote
-    validator: bytes
-    signature: bytes = b""
-
-    def to_wire(self) -> dict:
-        return {
-            "kind": "vote",
-            "vtype": self.vtype,
-            "height": self.height,
-            "round": self.round,
-            "block_id": self.block_id.hex(),
-            "validator": self.validator.hex(),
-            "signature": self.signature.hex(),
-        }
-
-    @classmethod
-    def from_wire(cls, d: dict) -> "Vote":
-        height = int(d["height"])
-        round_ = int(d["round"])
-        if height <= 0 or round_ < 0:
-            # negative ints would spin _varint forever in vote_sign_bytes
-            raise ValueError("vote fields out of range")
-        return cls(
-            vtype=d["vtype"],
-            height=height,
-            round=round_,
-            block_id=bytes.fromhex(d["block_id"]),
-            validator=bytes.fromhex(d["validator"]),
             signature=bytes.fromhex(d["signature"]),
         )
 

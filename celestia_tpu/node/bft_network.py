@@ -311,7 +311,7 @@ class BFTNetwork:
         return sum(v.power for v in self.validators if not v.crashed)
 
     def broadcast_tx(self, raw: bytes):
-        from celestia_tpu.client.signer import SubmitResult
+        from celestia_tpu.state.tx import SubmitResult
         from celestia_tpu.da.blob import unmarshal_blob_tx
         from celestia_tpu.state.tx import unmarshal_tx
 

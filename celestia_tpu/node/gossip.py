@@ -133,7 +133,7 @@ class _PeerLink:
 
     def _ensure_client(self):
         if self._client is None:
-            from celestia_tpu.client.remote import RemoteNode
+            from celestia_tpu.node.remote import RemoteNode
 
             try:
                 self._client = RemoteNode(
@@ -645,7 +645,7 @@ class GossipEngine:
     def _pull_client(self, addr: str):
         cli = self._pull_clients.get(addr)
         if cli is None:
-            from celestia_tpu.client.remote import RemoteNode
+            from celestia_tpu.node.remote import RemoteNode
 
             try:
                 cli = RemoteNode(addr, timeout_s=self.client_timeout_s)

@@ -168,7 +168,7 @@ class ValidatorNetwork:
 
     def broadcast_tx(self, raw: bytes):
         """Gossip emulation: CheckTx everywhere; pool on every validator."""
-        from celestia_tpu.client.signer import SubmitResult
+        from celestia_tpu.state.tx import SubmitResult
         from celestia_tpu.da.blob import unmarshal_blob_tx
         from celestia_tpu.state.tx import unmarshal_tx
 

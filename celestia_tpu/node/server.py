@@ -323,7 +323,7 @@ class NodeService:
         (utils/devprof.py), trace-ring health (span drops + background
         depth — silent truncation must be remotely detectable) and the
         alert engine's per-rule firing states."""
-        from celestia_tpu.client import remote as remote_mod
+        from celestia_tpu.node import remote as remote_mod
         from celestia_tpu.utils import devprof, faults
         from celestia_tpu.utils.telemetry import escape_label_value
 

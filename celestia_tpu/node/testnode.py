@@ -15,19 +15,18 @@ from __future__ import annotations
 import hashlib
 import threading
 import time as _time
-from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from celestia_tpu.appconsts import (
     CONTINUATION_SPARSE_SHARE_CONTENT_SIZE,
     GOAL_BLOCK_TIME_SECONDS,
 )
-from celestia_tpu.client.signer import SubmitResult
+from celestia_tpu.state.tx import SubmitResult
 from celestia_tpu.da.blob import unmarshal_blob_tx
 from celestia_tpu.node.mempool import Mempool
 from celestia_tpu.utils.lru import LruCache
 from celestia_tpu.state.ante import AnteContext, AnteError, run_ante
-from celestia_tpu.state.app import App, TxResult
+from celestia_tpu.state.app import App
 from celestia_tpu.state.auth import AccountKeeper
 from celestia_tpu.state.bank import BankKeeper
 from celestia_tpu.state.params import ParamsKeeper
@@ -35,26 +34,10 @@ from celestia_tpu.state.tx import unmarshal_tx
 from celestia_tpu.utils.secp256k1 import PrivateKey
 
 
-@dataclass
-class BlockHeader:
-    height: int
-    time_ns: int
-    chain_id: str
-    app_version: int
-    data_hash: bytes
-    app_hash: bytes  # state root AFTER this block
-    square_size: int
-
-
-@dataclass
-class Block:
-    header: BlockHeader
-    txs: List[bytes]
-    tx_results: List[TxResult] = field(default_factory=list)
-    # the commit info applied with this block (ABCI LastCommitInfo role);
-    # replayed verbatim during catch-up so app hashes reproduce
-    proposer: bytes = b""
-    votes: Optional[List[Tuple[bytes, bool]]] = None
+# Block/BlockHeader moved to state/consensus.py (celint R8: the
+# persistence layer replays them from state/, below node/); re-exported
+# here so node-side callers are unchanged.
+from celestia_tpu.state.consensus import Block, BlockHeader  # noqa: F401,E402
 
 
 class TestNode:

@@ -27,13 +27,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from celestia_tpu.appconsts import NAMESPACE_SIZE, SHARE_SIZE
-from celestia_tpu.da.namespace import PARITY_SHARE_NAMESPACE
+from celestia_tpu.appconsts import (
+    NAMESPACE_SIZE,
+    PARITY_SHARE_NAMESPACE_RAW,
+    SHARE_SIZE,
+)
 from celestia_tpu.ops.sha256 import sha256
 
 NMT_DIGEST_SIZE = 2 * NAMESPACE_SIZE + 32  # 90
 
-_PARITY_NS = np.frombuffer(PARITY_SHARE_NAMESPACE.raw, dtype=np.uint8)
+_PARITY_NS = np.frombuffer(PARITY_SHARE_NAMESPACE_RAW, dtype=np.uint8)
 
 
 def leaf_digests(leaves: jnp.ndarray) -> jnp.ndarray:

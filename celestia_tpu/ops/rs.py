@@ -424,6 +424,7 @@ def repair_square_device(
     out = fn(*fn_args)
     d.done(out)
     repaired_dev, mismatch_dev, provided_mismatch_dev, roots_dev = out
+    # celint: allow(host-sync) — t2 is the compute/fetch timing boundary of the repair breakdown; d.done() above only drains when profiling is armed, this sync must hold either way
     jax.block_until_ready(repaired_dev)
     t2 = _t.time()
     # ONE batched fetch of every verdict: per-array np.asarray pays a

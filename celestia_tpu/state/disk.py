@@ -342,8 +342,8 @@ class BlockLog:
     @classmethod
     def recover(cls, data_dir: str) -> List[object]:
         """All intact blocks, in order; truncates a torn tail."""
-        from celestia_tpu.node.testnode import Block, BlockHeader
         from celestia_tpu.state.app import TxResult
+        from celestia_tpu.state.consensus import Block, BlockHeader
 
         path = os.path.join(data_dir, "blocks.log")
         blocks: List[object] = []

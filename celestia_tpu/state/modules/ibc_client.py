@@ -36,7 +36,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from celestia_tpu.node.bft import (
+from celestia_tpu.state.consensus import (
     PRECOMMIT,
     Vote,
     block_id_of,

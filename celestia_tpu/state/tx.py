@@ -48,6 +48,20 @@ def _get_bytes(raw: bytes, pos: int) -> Tuple[bytes, int]:
 # ---------------------------------------------------------------------------
 
 
+@dataclass
+class SubmitResult:
+    """Outcome of a tx broadcast (the BroadcastTx RPC surface).  Lives
+    here — not in client/signer.py where it grew up — because the node
+    tier PRODUCES it (testnode broadcast, network replication) and the
+    client tier consumes it: state/ is the layer both may import
+    (celint R8)."""
+
+    code: int
+    log: str
+    tx_hash: bytes
+    height: Optional[int] = None
+
+
 @dataclass(frozen=True)
 class MsgSend:
     """x/bank transfer (the reference's most common non-blob tx)."""

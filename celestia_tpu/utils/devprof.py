@@ -437,13 +437,18 @@ def note_compile(name: str, fn, args: Tuple[Any, ...]) -> None:
         name=f"devprof-compile-{name}", daemon=True,
     )
     with _lock:
-        if len(_compile_threads) >= _MAX_OUTSTANDING_COMPILES:
-            note(
-                "compile_queue",
-                RuntimeError(f"outstanding-compile cap hit; dropped {name}"),
-            )
-            return
-        _compile_threads.append(t)
+        # note() ALSO takes _lock — it must be called after release (it
+        # was not, once: celint R6's founding self-deadlock, hit exactly
+        # when the outstanding-compile cap fired under armed profiling)
+        dropped = len(_compile_threads) >= _MAX_OUTSTANDING_COMPILES
+        if not dropped:
+            _compile_threads.append(t)
+    if dropped:
+        note(
+            "compile_queue",
+            RuntimeError(f"outstanding-compile cap hit; dropped {name}"),
+        )
+        return
     t.start()
 
 

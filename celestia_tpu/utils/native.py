@@ -216,6 +216,7 @@ def _ensure_field(lib) -> None:
     table-method GF legs here stay bit-identical to the device path.
     Callers must hold ``_field_lock`` across this AND the native call."""
     global _loaded_codec
+    # celint: allow(layering) — native is the C twin of ops/gf256: both sides must share ONE codec pin and ONE mul table or the byte-identity contract breaks; the import is lazy and utils/ has no module-level dependency on ops/
     from celestia_tpu.ops import gf256
 
     codec = gf256.active_codec()
@@ -242,6 +243,7 @@ def _resolve_threads(nthreads: Optional[int]) -> int:
 
 def rs_extend_square(square: np.ndarray) -> np.ndarray:
     """uint8[k, k, B] -> uint8[2k, 2k, B] (bit-identical to the device)."""
+    # celint: allow(layering) — byte-identity twin: the native leg must use the SAME encode matrix as the device path (ops/gf256 owns it); lazy import, no module-level edge
     from celestia_tpu.ops.gf256 import encode_matrix
 
     lib = _load()
@@ -298,6 +300,7 @@ def extend_block_cpu(square: np.ndarray, nthreads: Optional[int] = None):
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
+    # celint: allow(layering) — byte-identity twin: same encode matrix as the device path (see rs_extend_square)
     from celestia_tpu.ops.gf256 import encode_matrix
 
     square = np.ascontiguousarray(square, dtype=np.uint8)

@@ -49,16 +49,56 @@ def chaos():
     degradation test is force-cleared so later tests see the real
     library.  Arm points with ``chaos.arm(...)`` (seeded; same seed =>
     same schedule) and reproduce any chaos failure by re-arming with the
-    seed the failing test printed."""
-    from celestia_tpu.utils import faults, native
+    seed the failing test printed.
+
+    When the lock-order shadow checker's factories are installed
+    (CELESTIA_TPU_LOCKWATCH runs — `make lockwatch`), the fixture also
+    arms recording for the test body, so chaos scenarios execute with
+    lock-order observation on."""
+    from celestia_tpu.utils import faults, lockwatch, native
 
     faults.disarm()
     faults.reset_stats()
+    rearm = lockwatch.installed() and not lockwatch.armed()
+    if rearm:
+        lockwatch.arm()
     yield faults
+    if rearm:
+        lockwatch.disarm()
     faults.disarm()
     faults.reset_stats()
     if native.poisoned() is not None:
         native.clear_poison(force=True)
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _lockwatch_gate():
+    """`make lockwatch` contract: when the shadow checker was armed from
+    the environment, the WHOLE session fails if any lock-order inversion
+    was observed — with both acquisition stacks in the failure."""
+    yield
+    if not os.environ.get("CELESTIA_TPU_LOCKWATCH", "").strip():
+        return
+    from celestia_tpu.utils import lockwatch
+
+    print("\n" + lockwatch.report())
+    if lockwatch.inversions():
+        pytest.fail(
+            "lock-order inversions observed at runtime:\n"
+            + lockwatch.report(),
+            pytrace=False,
+        )
+    # static cross-check: an observed order that CONTRADICTS the derived
+    # lock hierarchy fails even when no thread raced the reverse order
+    from celestia_tpu.lint.lockorder import runtime_crosscheck
+
+    problems = runtime_crosscheck(lockwatch.observed_pairs())
+    if problems:
+        pytest.fail(
+            "runtime lock orders contradict the static lock graph:\n"
+            + "\n".join(problems),
+            pytrace=False,
+        )
 
 
 @pytest.fixture(autouse=True, scope="module")

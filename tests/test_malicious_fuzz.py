@@ -11,7 +11,7 @@ import pytest
 from celestia_tpu.client.signer import Signer
 from celestia_tpu.da.blob import Blob, BlobTx
 from celestia_tpu.da.namespace import Namespace
-from celestia_tpu.node import txsim
+from celestia_tpu.client import txsim
 from celestia_tpu.node.malicious import HANDLER_REGISTRY, MaliciousApp
 from celestia_tpu.node.testnode import TestNode
 from celestia_tpu.state.app import App

@@ -11,7 +11,7 @@ import numpy as np
 
 from celestia_tpu.client.remote import RemoteNode
 from celestia_tpu.client.signer import Signer
-from celestia_tpu.node import txsim
+from celestia_tpu.client import txsim
 from celestia_tpu.node.server import NodeServer
 from celestia_tpu.node.testnode import TestNode
 from celestia_tpu.state.store import MultiStore

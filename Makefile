@@ -7,7 +7,7 @@ PY ?= python
 # passes --format through; exit codes are unchanged either way
 LINT_FORMAT ?=
 
-.PHONY: lint lockwatch test chaos trace-smoke profile-smoke bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
+.PHONY: lint lockwatch test chaos trace-smoke profile-smoke incident-smoke t1-budget bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
 
 ## celint: concurrency & determinism static analysis (exit 1 on findings)
 lint:
@@ -49,6 +49,24 @@ trace-smoke:
 ## /metrics (tier-1 runs the same assertions via tests/test_profile_smoke.py)
 profile-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/profile_smoke.py
+
+## host-observability boot gate: a traced tiny-k validator with the
+## host sampler + flight recorder armed is driven through one real
+## block, then synthetically height-stalled with an injected stall
+## rule — the alert firing must produce an on-disk incident bundle
+## (valid manifest, Chrome trace with cat="sample" events on host
+## thread tracks, non-empty folded stacks) retrievable via `query
+## incident --out` against the live RPC; a second leg proves the
+## disarmed path writes nothing and costs <1% (tier-1 runs the same
+## assertions via tests/test_incident_smoke.py)
+incident-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/incident_smoke.py
+
+## tier-1 wall-time budget guard: judges the per-test durations file
+## the last pytest session wrote (conftest) — fails loudly when any
+## single non-slow test exceeded 30 s (the 870 s tier-1 run truncates)
+t1-budget:
+	$(PY) tools/t1_budget.py
 
 ## bench regression watchdog: compares every headline metric's latest
 ## BENCH_r*.json value against best-so-far (25% tolerance); exits loud

@@ -639,6 +639,57 @@ def _trace_summary(k: int) -> dict:
         tracing.clear()
 
 
+def _host_profile_extras(k: int) -> dict:
+    """extras.host_profile (BASELINE.md): the HOST half of the profile
+    — the wall-clock sampling profiler (utils/hostprof.py) armed around
+    one cold prepare -> warm process leg at k.  Reports the top-N
+    self-time frames (leaf-frame sample counts: where the host CPU
+    actually was, including the untraced tails no span names), the
+    sampler's achieved samples/sec and its measured self-overhead as a
+    percent of the leg wall (tools/bench_check.py alarms when that
+    figure exceeds 2%).  The sampler is armed only for this leg and
+    fully torn down after."""
+    from celestia_tpu.utils import hostprof
+
+    n_tx = max(2, k)
+    blob_bytes = max(478, (k * k * 478) // max(1, n_tx) - 4 * 478)
+    # a dedicated seed (content-addressed EDS cache): the profiled
+    # prepare must extend COLD so the samples cover real extension work
+    node, txs = _make_pfb_node_and_txs(n_tx, blob_bytes, 17, k, b"hostprof")
+    node.app.prepare_proposal(txs[:2])  # warm programs/caches unprofiled
+    hostprof.clear()
+    hostprof.start(200.0)
+    t0 = time.time()
+    try:
+        prop = node.app.prepare_proposal(txs)
+        # one deterministic mid-leg sample: a tiny-k leg can finish
+        # inside a single sampler tick, and an empty profile would read
+        # as "sampler broken" to the watchdog (its cost is measured
+        # into overhead_pct like any tick — nothing is hidden)
+        hostprof.sample_once()
+        ok, reason = node.app.process_proposal(
+            prop.block_txs, prop.square_size, prop.data_root
+        )
+        assert ok, f"host_profile round rejected its own block: {reason}"
+        leg_wall_ms = (time.time() - t0) * 1000.0
+    finally:
+        hostprof.stop()
+    st = hostprof.stats()
+    out = {
+        "k": k,
+        "square": prop.square_size,
+        "hz": st["hz"],
+        "leg_wall_ms": round(leg_wall_ms, 1),
+        "samples_total": st["samples_total"],
+        "samples_per_s": st["samples_per_s"],
+        "sampler_overhead_pct": st["overhead_pct"],
+        "folded_unique": st["folded_unique"],
+        "top_frames": hostprof.top_frames(10),
+    }
+    hostprof.clear()
+    return out
+
+
 def _device_profile_extras(k: int) -> dict:
     """extras.device_profile (BASELINE.md): per-kernel XLA FLOPs /
     bytes-accessed / measured compile ms, per-dispatch counts + busy ms,
@@ -927,6 +978,13 @@ def _host_only_main():
         extras["trace_summary"] = _trace_summary(K)
     except Exception as e:
         extras["trace_summary_error"] = repr(e)[:200]
+    try:
+        # host sampling profiler around one prepare->process leg: top
+        # self-time frames + the measured sampler overhead the watchdog
+        # alarms on (>2% of leg wall)
+        extras["host_profile"] = _host_profile_extras(K)
+    except Exception as e:
+        extras["host_profile_error"] = repr(e)[:200]
     try:
         # device plane on the CPU fallback: the XLA CPU backend still
         # answers cost analysis for a TINY program; memory_stats folds

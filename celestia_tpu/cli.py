@@ -338,6 +338,17 @@ def cmd_start(args) -> int:
                 "continuing without capture"
             )
             device_profile_dir = None
+    # --host-profile without a value (the -1 sentinel) means "the
+    # default rate"; an EXPLICIT 0 means off — matching the sibling
+    # --timeseries-interval convention, so a wrapper templating the
+    # flag can disable profiling without dropping the flag entirely
+    raw_hp = getattr(args, "host_profile", None)
+    host_profile_hz = None
+    if raw_hp is not None and raw_hp != 0:
+        from celestia_tpu.utils import hostprof
+
+        host_profile_hz = raw_hp if raw_hp > 0 else hostprof.DEFAULT_HZ
+    flight_dir = getattr(args, "flight_dir", None)
     server = NodeServer(
         node,
         address=cfg.grpc.address,
@@ -351,10 +362,22 @@ def cmd_start(args) -> int:
         metrics_port=getattr(args, "metrics_port", None),
         # continuous telemetry snapshots (0 disables the sampler)
         timeseries_interval_s=getattr(args, "timeseries_interval", 5.0),
+        # continuous host profiling (utils/hostprof.py; off by default)
+        host_profile_hz=host_profile_hz,
+        # anomaly flight recorder (utils/flight.py; off by default)
+        flight_dir=flight_dir,
     )
     server.start()
     if server.metrics_http is not None:
-        log.info("metrics HTTP endpoint", address=server.metrics_http.address)
+        log.info(
+            "metrics HTTP endpoint", address=server.metrics_http.address
+        )
+    if host_profile_hz:
+        from celestia_tpu.utils import hostprof
+
+        log.info("host profiler sampling", hz=hostprof.hz())
+    if flight_dir:
+        log.info("flight recorder armed", dir=flight_dir)
     gossip = None
     if getattr(args, "peers", None) and getattr(args, "bft_valset", None):
         # p2p mesh mode: flood consensus messages directly between
@@ -616,6 +639,90 @@ def cmd_query(args) -> int:
         }, indent=1))
         if firing and args.fail_on_firing:
             return 1
+    elif args.query_cmd == "host-profile":
+        out = node.host_profile(top=args.top, folded=args.folded)
+        if args.out:
+            Path(args.out).write_text(
+                "\n".join(
+                    f"{stack} {count}"
+                    for stack, count in sorted(
+                        out.get("folded", {}).items(),
+                        key=lambda kv: (-kv[1], kv[0]),
+                    )
+                )
+                + "\n"
+            )
+        print(json.dumps({
+            "node_id": out.get("node_id", ""),
+            "stats": out.get("stats", {}),
+            "top_frames": out.get("top_frames", []),
+            **({"written": args.out} if args.out
+               else {"folded": out.get("folded", {})}),
+        }, indent=1))
+    elif args.query_cmd == "incidents":
+        print(json.dumps(node.flight_list(), indent=1))
+    elif args.query_cmd == "incident":
+        out = node.flight_fetch(args.id)
+        if not out.get("found"):
+            print(json.dumps(out))
+            return 1
+        if args.out:
+            written = _write_bundle_files(Path(args.out), out)
+            print(json.dumps({
+                "id": out["manifest"]["id"],
+                "reason": out["manifest"].get("reason", ""),
+                "written": written,
+            }, indent=1))
+        else:
+            print(json.dumps({"manifest": out["manifest"]}, indent=1))
+    elif args.query_cmd == "cluster-incidents":
+        # per-peer incident rollup; with --out, every bundle is pulled
+        # mesh-wide into <out>/<node_id>/<incident_id>/
+        clients = _cluster_clients(node, args)
+        try:
+            report = []
+            for client in clients:
+                addr = str(getattr(client, "address", ""))
+                try:
+                    listing = client.flight_list()
+                except Exception as e:
+                    report.append({"node": addr, "error": str(e)[:200]})
+                    continue
+                entry = {
+                    "node": addr,
+                    "enabled": listing.get("enabled", False),
+                    "incidents": listing.get("incidents", []),
+                }
+                if args.out and entry["enabled"]:
+                    fetched = []
+                    for inc in entry["incidents"]:
+                        if "error" in inc:
+                            continue
+                        bundle = client.flight_fetch(inc["id"])
+                        if not bundle.get("found"):
+                            continue
+                        # peer-supplied node id: reduce to a safe slug
+                        # (a hostile ".." or "/abs" must stay inside
+                        # --out)
+                        import re as _re
+
+                        nid = _re.sub(
+                            r"[^A-Za-z0-9_.-]+", "_",
+                            str(inc.get("node_id") or addr or "node"),
+                        ).strip(".") or "node"
+                        fetched.extend(_write_bundle_files(
+                            Path(args.out) / nid, bundle
+                        ))
+                    entry["written"] = fetched
+                report.append(entry)
+            print(json.dumps({
+                "peers": report,
+                "incidents_total": sum(
+                    len(e.get("incidents", [])) for e in report
+                ),
+            }, indent=1))
+        finally:
+            _close_clients(clients, node)
     elif args.query_cmd == "trace-dump":
         out = node.trace_dump(last=args.last or None)
         if args.out:
@@ -760,6 +867,42 @@ def cmd_query(args) -> int:
             ],
         }))
     return 0
+
+
+def _write_bundle_files(out_dir: Path, bundle: dict) -> list:
+    """Write one fetched incident bundle (FlightFetch shape) under
+    ``out_dir/<incident_id>/`` — manifest + every artifact, exactly the
+    on-disk layout the recorder keeps.  Returns the written paths.
+
+    Bundles arrive from REMOTE peers (cluster-incidents walks the PEX
+    mesh), so nothing in them is trusted: the incident id must match
+    the recorder's own id grammar (a hostile "../x" or absolute id
+    would otherwise escape --out via the Path join), and artifact
+    names must be bare basenames."""
+    from celestia_tpu.utils.flight import _ID_RE
+
+    incident_id = str(bundle["manifest"]["id"])
+    if not _ID_RE.match(incident_id):
+        raise SystemExit(
+            f"refusing to write bundle with hostile incident id "
+            f"{incident_id!r}"
+        )
+    dest = out_dir / incident_id
+    dest.mkdir(parents=True, exist_ok=True)
+    written = []
+    mpath = dest / "manifest.json"
+    mpath.write_text(json.dumps(bundle["manifest"], indent=1, sort_keys=True))
+    written.append(str(mpath))
+    for name, text in sorted(bundle.get("files", {}).items()):
+        # artifact names come from the server; never let a hostile one
+        # escape the destination directory
+        safe = os.path.basename(name)
+        if not safe or safe != name:
+            continue
+        fpath = dest / safe
+        fpath.write_text(text)
+        written.append(str(fpath))
+    return written
 
 
 def _cluster_clients(seed, args):
@@ -1366,6 +1509,23 @@ def build_parser() -> argparse.ArgumentParser:
              "ring + alert engine (0 disables the sampler; the RPC "
              "still samples on demand)",
     )
+    sp.add_argument(
+        "--host-profile", nargs="?", const=-1.0, type=float, default=None,
+        metavar="HZ",
+        help="continuous host profiling: sample every thread's stack at "
+             "HZ (default rate when given bare; 0 disables), joined to "
+             "live spans and served by the HostProfile RPC; folded "
+             "stacks + Chrome sample events land in flight bundles "
+             "(CELESTIA_TPU_HOST_PROFILE is equivalent)",
+    )
+    sp.add_argument(
+        "--flight-dir", default=None, metavar="DIR",
+        help="arm the anomaly flight recorder: alert firing transitions "
+             "(and slow blocks over CELESTIA_TPU_FLIGHT_SLOW_BLOCK_MS) "
+             "dump a bounded incident bundle (trace + timeseries + "
+             "metrics + folded stacks + fault notes) into a size-capped "
+             "ring of dirs under DIR, served by FlightList/FlightFetch",
+    )
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser(
@@ -1498,6 +1658,45 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print only the rules currently firing")
     q.add_argument("--fail-on-firing", action="store_true",
                    help="exit 1 when any rule fires (CI/automation probe)")
+    q = qs.add_parser(
+        "host-profile",
+        help="the node's host sampling-profiler view: sampler stats, "
+             "top self-time frames, folded stacks (flamegraph input)",
+    )
+    q.add_argument("--top", type=int, default=25,
+                   help="how many self-time frames to report")
+    q.add_argument("--folded", type=int, default=200,
+                   help="how many folded stacks to include (by count)")
+    q.add_argument("--out", default=None,
+                   help="also write the folded stacks to this file "
+                        "(one 'stack count' line each — feed it to "
+                        "flamegraph.pl / speedscope)")
+    q = qs.add_parser(
+        "incidents",
+        help="list the node's kept flight-recorder incident bundles",
+    )
+    q = qs.add_parser(
+        "incident",
+        help="fetch one incident bundle (default: the newest) and "
+             "write its artifacts to --out",
+    )
+    q.add_argument("--id", default="",
+                   help="incident id (from `query incidents`; default: "
+                        "the newest bundle)")
+    q.add_argument("--out", default=None,
+                   help="directory to write the bundle's files into "
+                        "(created; default: print the manifest only)")
+    q = qs.add_parser(
+        "cluster-incidents",
+        help="collect flight-recorder incident lists (and, with --out, "
+             "the bundles) from every peer in the mesh",
+    )
+    q.add_argument("--nodes", default=None,
+                   help="comma-separated peer gRPC addresses (default: "
+                        "--node plus its PEX-reported peers)")
+    q.add_argument("--out", default=None,
+                   help="directory to download every peer's bundles into "
+                        "(<out>/<node_id>/<incident_id>/...)")
     q = qs.add_parser(
         "trace-dump",
         help="last N block traces as Chrome trace JSON (open in Perfetto)",

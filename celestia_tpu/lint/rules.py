@@ -289,6 +289,14 @@ SANCTIONED_CHANNELS = (
     "celestia_tpu/utils/devprof.py",
     # the continuous-telemetry ring stamps snapshot timestamps
     "celestia_tpu/utils/timeseries.py",
+    # the host sampling profiler stamps sample timestamps and measures
+    # its own tick cost; its ids are thread ids + folded strings, so the
+    # entropy bans apply (a randomized sampler would launder
+    # nondeterminism through the one open door)
+    "celestia_tpu/utils/hostprof.py",
+    # the flight recorder stamps incident timestamps; incident ids are
+    # SEQUENCE numbers, never random — entropy bans enforced
+    "celestia_tpu/utils/flight.py",
 )
 
 

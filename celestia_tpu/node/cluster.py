@@ -331,6 +331,12 @@ def _peer_health(client) -> dict:
         # declarative alert states (utils/timeseries.py): rule -> firing
         "alerts": alerts,
         "alerts_firing": sum(1 for v in alerts.values() if v),
+        # flight-recorder incident count (lifetime, from the scrape) —
+        # a node that has been black-boxing incidents is visible
+        # mesh-wide without a second RPC
+        "incidents": int(
+            by_name.get("celestia_tpu_flight_incidents_total", 0)
+        ),
     }
 
 
@@ -389,6 +395,13 @@ def cluster_health(clients, probes: int = 3) -> dict:
         "alerts_firing": sum(p.get("alerts_firing", 0) for p in healthy),
         "degraded_peers": sorted(
             p["node_id"] for p in healthy if p.get("alerts_firing", 0) > 0
+        ),
+        # flight-recorder rollup: total incidents across the mesh plus
+        # every peer that captured at least one (named, like
+        # degraded_peers — the operator pulls those bundles first)
+        "incidents": sum(p.get("incidents", 0) for p in healthy),
+        "incident_peers": sorted(
+            p["node_id"] for p in healthy if p.get("incidents", 0) > 0
         ),
         "collector_node_id": tracing.node_id(),
     }

@@ -197,6 +197,43 @@ class RemoteNode:
             payload["last"] = int(last)
         return self._call_json("TimeSeries", payload)
 
+    def host_profile(self, top: int = 25, folded: int = 200) -> dict:
+        """The node's host sampling-profiler view (the ``HostProfile``
+        RPC): ``{"stats", "top_frames", "folded"}`` — folded stacks are
+        bounded to the top N by count."""
+        return self._call_json(
+            "HostProfile", {"top": int(top), "folded": int(folded)}
+        )
+
+    def flight_list(self) -> dict:
+        """Kept incident-bundle manifests + recorder ring stats (the
+        ``FlightList`` RPC); ``{"enabled": false}`` on a node running
+        without --flight-dir."""
+        return self._call_json("FlightList", {})
+
+    def flight_fetch(self, incident_id: str = "") -> dict:
+        """One full incident bundle (the ``FlightFetch`` RPC): manifest
+        plus every artifact as text.  Empty id fetches the newest.
+        Large bundles arrive file-by-file (the server answers
+        ``files_inline: false`` when the inline form would blow this
+        channel's 4 MiB receive cap); the per-file fetches are folded
+        back into the inline shape, so callers never see the split."""
+        out = self._call_json("FlightFetch", {"id": incident_id})
+        if not out.get("found") or out.get("files_inline") is not False:
+            return out
+        files = {}
+        inc_id = out["manifest"]["id"]
+        for entry in out["manifest"].get("files", []):
+            name = entry.get("name", "")
+            part = self._call_json(
+                "FlightFetch", {"id": inc_id, "file": name}
+            )
+            if part.get("found"):
+                files[name] = part.get("content", "")
+                if part.get("truncated"):
+                    files[name] += "\n<truncated by transport cap>"
+        return {"found": True, "manifest": out["manifest"], "files": files}
+
     def clock_probe(self) -> dict:
         """One peer telemetry-clock read: ``{"ts", "node_id",
         "height"}`` (the ClockProbe RPC)."""

@@ -36,9 +36,19 @@ Methods (service ``celestia.tpu.v1.Node``):
                verdicts; every call records one fresh sample first, so
                two consecutive calls always yield a computable rate
 
+  HostProfile  {"top": N, "folded": M}    -> the host sampling
+               profiler's stats, top self-time frames and folded
+               stacks (utils/hostprof.py)
+  FlightList   {}                         -> kept incident-bundle
+               manifests + recorder ring stats (utils/flight.py)
+  FlightFetch  {"id": str}                -> one full incident bundle
+               (manifest + every artifact as text; empty id = newest)
+
 The same exposition is optionally served as PLAIN HTTP (``GET
 /metrics`` on ``--metrics-port``; off by default) so a stock Prometheus
-scrapes the node without speaking the custom gRPC framing.
+scrapes the node without speaking the custom gRPC framing, plus a
+``GET /healthz`` JSON probe (node id, height, breakers open, alerts
+firing, uptime) for load balancers and orchestrators.
 
 Cross-node trace context: consensus, gossip, state-sync and DAS
 requests may carry an optional ``"_tc"`` envelope field (specs/
@@ -72,8 +82,9 @@ def _identity(b: bytes) -> bytes:
 class NodeService:
     """Method implementations over an in-process node (TestNode surface)."""
 
-    def __init__(self, node, das_max_inflight: int = 4):
+    def __init__(self, node, das_max_inflight: int = 4, flight=None):
         from celestia_tpu.utils import timeseries as ts_mod
+        from celestia_tpu.utils.telemetry import clock
 
         self.node = node
         # continuous telemetry: the bounded snapshot ring + the alert
@@ -82,6 +93,12 @@ class NodeService:
         self.alert_engine = ts_mod.AlertEngine(ts_mod.default_rules())
         for rule in ts_mod.rules_from_env():
             self.alert_engine.add_rule(rule)
+        # anomaly flight recorder (utils/flight.py): None unless the
+        # operator gave --flight-dir; fed firing transitions from every
+        # sampler tick / TimeSeries RPC below
+        self.flight = flight
+        # service birth (telemetry clock) for the /healthz uptime field
+        self._t0 = clock()
         # DAS serving-plane admission (specs/robustness.md): sampling
         # requests above the inflight bound are SHED with a retry-after
         # hint instead of queueing behind the service lock until every
@@ -347,6 +364,24 @@ class NodeService:
             )
         # device plane (XLA cost table, per-chip busy ms, mem watermark)
         lines.extend(devprof.exposition_lines())
+        # host profiler (sampler rates + measured self-overhead)
+        from celestia_tpu.utils import hostprof
+
+        lines.extend(hostprof.exposition_lines())
+        # flight recorder: lifetime incident seq (cluster_health reads
+        # the per-peer count straight off the scrape) + kept-ring depth
+        if self.flight is not None:
+            fst = self.flight.stats()
+            lines.append(
+                "# TYPE celestia_tpu_flight_incidents_total counter"
+            )
+            lines.append(
+                "celestia_tpu_flight_incidents_total "
+                f"{fst['incidents_total']}"
+            )
+            lines.append(
+                f"celestia_tpu_flight_incidents_kept {fst['incidents_kept']}"
+            )
         # trace-ring health (satellite: remote truncation detectability)
         rs = tracing.ring_stats()
         lines.append(
@@ -375,10 +410,13 @@ class NodeService:
         text bytes — point a scraper straight at the RPC."""
         return self.metrics_text().encode()
 
-    def sample_timeseries(self) -> None:
+    def sample_timeseries(self):
         """Record ONE snapshot of the node's operational signals into
         the ring (the sampler thread's tick; also the on-demand sample
-        every TimeSeries RPC takes before answering)."""
+        every TimeSeries RPC takes before answering).  Returns the
+        alert verdicts the flight tick computed (None when no recorder
+        is armed) so the TimeSeries RPC never evaluates the engine a
+        second time for the same tick."""
         from celestia_tpu.utils import faults, timeseries as ts_mod
 
         try:
@@ -386,6 +424,44 @@ class NodeService:
         except Exception as e:
             # a collector bug degrades the ring, never the node
             faults.note("timeseries.sample", e)
+        verdicts = None
+        if self.flight is not None:
+            verdicts = self.alert_engine.evaluate(self.timeseries)
+            self.flight_tick(verdicts)
+        return verdicts
+
+    def flight_tick(self, verdicts=None) -> None:
+        """Feed the flight recorder: alert firing TRANSITIONS over the
+        fresh sample trigger an incident bundle, and the newest block
+        trace is judged against the slow-block threshold.  A recorder
+        bug degrades to a fault note, never the node.  A caller that
+        has already evaluated the engine passes its ``verdicts`` so one
+        tick never evaluates twice."""
+        if self.flight is None:
+            return
+        from celestia_tpu.utils import faults
+
+        try:
+            if verdicts is None:
+                verdicts = self.alert_engine.evaluate(self.timeseries)
+            inc = self.flight.on_alerts(
+                verdicts,
+                height=int(getattr(self.node, "height", 0) or 0),
+                # callables: resolved only when a bundle actually dumps,
+                # so the steady-state tick never builds an exposition
+                metrics_text=self.metrics_text,
+                timeseries_snapshots=self.timeseries.samples,
+            )
+            if inc is None and self.flight.slow_block_ms is not None:
+                for tr in tracing.block_traces(last=1):
+                    breakdown = tracing.TRACER.phase_breakdown(tr)
+                    self.flight.on_block(
+                        tr.height, breakdown.get("total_ms", 0.0),
+                        metrics_text=self.metrics_text,
+                        timeseries_snapshots=self.timeseries.samples,
+                    )
+        except Exception as e:
+            faults.note("flight.tick", e)
 
     def time_series(self, req: bytes, ctx) -> bytes:
         """The continuous-telemetry ring + alert verdicts.  One fresh
@@ -393,7 +469,9 @@ class NodeService:
         return >= 2 snapshots with a computable rate — a fresh node is
         queryable immediately, no waiting on the sampler cadence."""
         q = json.loads(req or b"{}")
-        self.sample_timeseries()
+        verdicts = self.sample_timeseries()
+        if verdicts is None:  # no recorder armed: the tick skipped it
+            verdicts = self.alert_engine.evaluate(self.timeseries)
         last = q.get("last")
         snapshots = self.timeseries.samples(
             int(last) if last is not None else None
@@ -405,7 +483,7 @@ class NodeService:
                 "max_samples": self.timeseries.max_samples,
                 "snapshots": snapshots,
                 "rates": self.timeseries.rates(),
-                "alerts": self.alert_engine.evaluate(self.timeseries),
+                "alerts": verdicts,
             }
         ).encode()
 
@@ -438,6 +516,132 @@ class NodeService:
                 "trace": dump,
             }
         ).encode()
+
+    def host_profile(self, req: bytes, ctx) -> bytes:
+        """The host sampling profiler's state (utils/hostprof.py):
+        sampler stats, top self-time frames and the folded stacks
+        (bounded to the top N by count so the response stays under the
+        transport cap even on a long-running node)."""
+        from celestia_tpu.utils import hostprof
+
+        q = json.loads(req or b"{}")
+        top = int(q.get("top", 25) or 25)
+        folded = sorted(
+            hostprof.folded_stacks().items(), key=lambda kv: (-kv[1], kv[0])
+        )[: max(1, int(q.get("folded", 200) or 200))]
+        return json.dumps(
+            {
+                "node_id": tracing.node_id(),
+                "stats": hostprof.stats(),
+                "top_frames": hostprof.top_frames(top),
+                "folded": dict(folded),
+            }
+        ).encode()
+
+    def flight_list(self, req: bytes, ctx) -> bytes:
+        """Manifest summaries of every kept incident bundle (oldest
+        first), plus the recorder's ring stats.  ``enabled: false`` when
+        the node runs without --flight-dir."""
+        if self.flight is None:
+            return json.dumps(
+                {"enabled": False, "incidents": [], "stats": {}}
+            ).encode()
+        return json.dumps(
+            {
+                "enabled": True,
+                "incidents": self.flight.list_incidents(),
+                "stats": self.flight.stats(),
+            }
+        ).encode()
+
+    # stay safely under RemoteNode.MAX_RECV_BYTES (4 MiB): a bundle
+    # whose artifacts exceed this is served file-by-file instead of
+    # inline, and a single oversized artifact is truncated with a
+    # marker rather than made irretrievable
+    FLIGHT_INLINE_MAX = 2 * 1024 * 1024
+    FLIGHT_FILE_MAX = 3 * 1024 * 1024
+
+    def flight_fetch(self, req: bytes, ctx) -> bytes:
+        """One incident bundle by id ({"id": ...}; empty id = the
+        newest).  Small bundles return manifest + every artifact
+        inline; a bundle that would blow the client's 4 MiB transport
+        cap returns ``files_inline: false`` and the client re-fetches
+        each artifact with ``{"id", "file": <name>}``."""
+        q = json.loads(req or b"{}")
+        if self.flight is None:
+            return json.dumps({"found": False, "enabled": False}).encode()
+        incident_id = str(q.get("id", "") or "")
+        if not incident_id:
+            incidents = self.flight.list_incidents()
+            if not incidents:
+                return json.dumps({"found": False}).encode()
+            incident_id = incidents[-1]["id"]
+        bundle = self.flight.load_bundle(incident_id)
+        if bundle is None:
+            return json.dumps({"found": False, "id": incident_id}).encode()
+        name = str(q.get("file", "") or "")
+        if name:
+            content = bundle["files"].get(name)
+            if content is None:
+                return json.dumps(
+                    {"found": False, "id": incident_id, "file": name}
+                ).encode()
+            truncated = len(content) > self.FLIGHT_FILE_MAX
+            if truncated:
+                content = content[: self.FLIGHT_FILE_MAX]
+            return json.dumps(
+                {
+                    "found": True, "id": incident_id, "file": name,
+                    "content": content, "truncated": truncated,
+                }
+            ).encode()
+        total = sum(len(v) for v in bundle["files"].values())
+        if total > self.FLIGHT_INLINE_MAX:
+            return json.dumps(
+                {
+                    "found": True,
+                    "manifest": bundle["manifest"],
+                    "files_inline": False,
+                }
+            ).encode()
+        return json.dumps({"found": True, **bundle}).encode()
+
+    def healthz(self) -> dict:
+        """The load-balancer / orchestrator probe body (plain-HTTP
+        ``GET /healthz`` on --metrics-port): one small JSON answering
+        "is this node serving and is anything on fire" without the full
+        exposition."""
+        from celestia_tpu.utils.telemetry import clock
+
+        breakers_open = 0
+        eng = getattr(self.node, "gossip_engine", None)
+        if eng is not None:
+            try:
+                breakers = eng.stats().get("pull_breakers", {})
+                breakers_open = sum(
+                    1 for s in breakers.values() if s != "closed"
+                )
+            except Exception as e:
+                faults.note("healthz.breakers", e)
+        firing = [
+            a["name"] for a in self.alert_engine.firing(self.timeseries)
+        ]
+        return {
+            "status": "degraded" if firing else "ok",
+            "node_id": tracing.node_id(),
+            "chain_id": getattr(self.node, "chain_id", ""),
+            "height": int(getattr(self.node, "height", 0) or 0),
+            "breakers_open": breakers_open,
+            "alerts_firing": firing,
+            "uptime_s": round(
+                max(0.0, clock() - self._t0), 3
+            ),
+            "incidents_kept": (
+                len(self.flight.list_incidents())
+                if self.flight is not None
+                else 0
+            ),
+        }
 
     def query(self, req: bytes, ctx) -> bytes:
         q = json.loads(req or b"{}")
@@ -551,6 +755,9 @@ class NodeService:
             "TraceDump": self.trace_dump,
             "ClockProbe": self.clock_probe,
             "TimeSeries": self.time_series,
+            "HostProfile": self.host_profile,
+            "FlightList": self.flight_list,
+            "FlightFetch": self.flight_fetch,
             "DasSample": self.das_sample,
             "ConsPrepare": self.cons_prepare,
             "ConsProcess": self.cons_process,
@@ -616,18 +823,29 @@ class _MetricsHTTPServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — stdlib handler contract
-                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
-                    self.send_error(404, "only /metrics is served")
-                    return
-                try:
-                    body = svc.metrics_text().encode()
-                except Exception as e:  # noqa: BLE001 — scraper gets a 500
-                    self.send_error(500, str(e)[:200])
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    # the orchestrator/load-balancer probe: small JSON,
+                    # never the full exposition (a probe every second
+                    # must not pay for histogram rendering)
+                    try:
+                        body = json.dumps(svc.healthz()).encode()
+                    except Exception as e:  # noqa: BLE001 — probe gets 500
+                        self.send_error(500, str(e)[:200])
+                        return
+                    ctype = "application/json; charset=utf-8"
+                elif path in ("/metrics", "/"):
+                    try:
+                        body = svc.metrics_text().encode()
+                    except Exception as e:  # noqa: BLE001 — scraper gets 500
+                        self.send_error(500, str(e)[:200])
+                        return
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                else:
+                    self.send_error(404, "only /metrics and /healthz are served")
                     return
                 self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
-                )
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -670,9 +888,24 @@ class NodeServer:
         das_max_inflight: int = 4,
         metrics_port: Optional[int] = None,
         timeseries_interval_s: Optional[float] = 5.0,
+        host_profile_hz: Optional[float] = None,
+        flight_dir: Optional[str] = None,
     ):
         self.node = node
-        self.service = NodeService(node, das_max_inflight=das_max_inflight)
+        # anomaly flight recorder: armed only by an explicit --flight-dir
+        flight = None
+        if flight_dir:
+            from celestia_tpu.utils.flight import FlightRecorder
+
+            flight = FlightRecorder(flight_dir)
+        self.service = NodeService(
+            node, das_max_inflight=das_max_inflight, flight=flight
+        )
+        # host sampling profiler: started/stopped with the server when a
+        # rate is given (the module may also be armed via env — in that
+        # case the server leaves ownership with whoever armed it)
+        self.host_profile_hz = host_profile_hz
+        self._owns_hostprof = False
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers)
         )
@@ -734,6 +967,12 @@ class NodeServer:
 
     def start(self) -> None:
         self._server.start()
+        if self.host_profile_hz:
+            from celestia_tpu.utils import hostprof
+
+            if not hostprof.enabled():
+                self._owns_hostprof = True
+            hostprof.start(self.host_profile_hz)
         if self.metrics_http is not None:
             self.metrics_http.start()
         if self.block_interval_s:
@@ -773,6 +1012,11 @@ class NodeServer:
     def stop(self, grace: float = 1.0) -> None:
         self._stop.set()
         self._server.stop(grace)
+        if self._owns_hostprof:
+            from celestia_tpu.utils import hostprof
+
+            hostprof.stop()
+            self._owns_hostprof = False
         if self.metrics_http is not None:
             self.metrics_http.stop()
         if self._producer is not None:

@@ -111,6 +111,14 @@ _current: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
     "celestia_tpu_trace_span", default=None
 )
 
+# live span per OS thread id — the CROSS-thread view the host sampling
+# profiler (utils/hostprof.py) joins wall-clock samples against
+# (contextvars are only readable from their own thread; a sampler
+# walking sys._current_frames() needs tid -> span).  Written only on
+# the enabled span enter/exit path; single dict item ops are atomic
+# under the GIL, so readers never need the tracer lock.
+_active_by_thread: Dict[int, Span] = {}
+
 
 class Span:
     """One timed operation.  ``t0``/``t1`` are telemetry-clock seconds."""
@@ -304,6 +312,7 @@ class Tracer:
             self._background.clear()
             self._agg.clear()
             self._span_drops_total = 0
+        _active_by_thread.clear()
 
     @property
     def max_blocks(self) -> int:
@@ -611,6 +620,7 @@ class _SpanCtx:
 
     def __enter__(self) -> Span:
         self._span._token = _current.set(self._span)
+        _active_by_thread[self._span.tid] = self._span
         return self._span
 
     def __exit__(self, exc_type, exc, tb) -> bool:
@@ -619,6 +629,15 @@ class _SpanCtx:
             s.args["error"] = repr(exc)[:200]
         s.t1 = clock()
         if s._token is not None:
+            # restore the thread's sampler-visible span to whatever was
+            # active before this one (the token records the old value)
+            old = s._token.old_value
+            if old is contextvars.Token.MISSING:
+                old = None
+            if old is None:
+                _active_by_thread.pop(s.tid, None)
+            else:
+                _active_by_thread[s.tid] = old
             _current.reset(s._token)
         self._tracer._finish(s)
         return False
@@ -667,6 +686,18 @@ def current() -> Optional[Span]:
     if not _enabled:
         return None
     return TRACER.current()
+
+
+def thread_span(tid: int) -> Optional[Span]:
+    """The span currently active on the thread with OS id ``tid`` —
+    the cross-thread join point for the host sampling profiler
+    (utils/hostprof.py): a wall-clock sample of a pool worker lands
+    under that worker's live ``hostpool.task`` span, so ``untraced_ms``
+    decomposes into named frames.  None when tracing is disabled or the
+    thread is between spans."""
+    if not _enabled:
+        return None
+    return _active_by_thread.get(tid)
 
 
 def record_span(

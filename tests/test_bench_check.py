@@ -134,6 +134,27 @@ def test_needs_two_parseable_rounds(tmp_path):
     assert bench_check.main(["--dir", str(tmp_path)]) == 2
 
 
+def test_host_profile_overhead_absolute_ceiling(tmp_path, capsys):
+    """The sampler-overhead budget is an ABSOLUTE 2% ceiling on the
+    latest round — never a best-so-far comparison (a lucky 0.1% round
+    must not make every later 0.5% round a failure)."""
+    ok = {"host_profile": {"sampler_overhead_pct": 0.1}}
+    still_ok = {"host_profile": {"sampler_overhead_pct": 1.9}}
+    bad = {"host_profile": {"sampler_overhead_pct": 2.5}}
+    # 0.1% -> 1.9% is a 19x jump but UNDER the ceiling: passes
+    _write_rounds(tmp_path, [_round(1, extras=ok), _round(2, extras=still_ok)])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+    # over the ceiling fails loudly and names the metric
+    _write_rounds(tmp_path, [_round(1, extras=ok), _round(2, extras=bad)])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "host_profile.sampler_overhead_pct" in err
+    assert "ceiling" in err
+    # a single round over the ceiling still fails (no baseline needed)
+    _write_rounds(tmp_path, [_round(1), _round(2, extras=bad)])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 1
+
+
 def test_check_series_semantics():
     rounds = [
         ("r1", {"m_ms": (10.0, False), "only_r1_ms": (5.0, False)}),

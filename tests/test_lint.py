@@ -313,6 +313,10 @@ def test_r3_sanctioned_channels_may_read_clocks():
     # clock through the same door and carry the same entropy bans
     assert "celestia_tpu/utils/devprof.py" in SANCTIONED_CHANNELS
     assert "celestia_tpu/utils/timeseries.py" in SANCTIONED_CHANNELS
+    # PR 13: the host sampling profiler + the flight recorder stamp
+    # sample/incident timestamps through the same sanctioned clock
+    assert "celestia_tpu/utils/hostprof.py" in SANCTIONED_CHANNELS
+    assert "celestia_tpu/utils/flight.py" in SANCTIONED_CHANNELS
     for rel in SANCTIONED_CHANNELS:
         assert _ids(_lint(R3_CHANNEL_CLOCK_OK, rel)) == [], rel
 

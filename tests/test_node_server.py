@@ -127,3 +127,38 @@ def test_simulate_and_param_queries(served_node):
     # unknown route -> clean error
     with pytest.raises(Exception):
         remote.abci_query("custom/unknown/route", {})
+
+
+def test_healthz_http_probe_and_metrics_routes():
+    """Satellite (PR 13): plain-HTTP GET /healthz next to /metrics on
+    --metrics-port — the orchestrator probe contract: JSON body with
+    node id, height, breakers, alerts firing and uptime; unknown paths
+    stay 404; /metrics keeps serving the exposition."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    node = TestNode(auto_produce=False)
+    node.produce_block()
+    with NodeServer(node, metrics_port=0) as server:
+        base = f"http://{server.metrics_http.address}"
+        body = urllib.request.urlopen(f"{base}/healthz", timeout=30)
+        assert body.headers["Content-Type"].startswith("application/json")
+        doc = _json.loads(body.read().decode())
+        assert doc["status"] == "ok"
+        assert doc["height"] == node.height
+        assert doc["breakers_open"] == 0
+        assert doc["alerts_firing"] == []
+        assert doc["uptime_s"] >= 0
+        assert doc["chain_id"] == node.chain_id
+        # /metrics still serves the exposition on the same port
+        text = urllib.request.urlopen(
+            f"{base}/metrics", timeout=30
+        ).read().decode()
+        assert "celestia_tpu" in text
+        # unknown paths are 404, not silently healthz
+        try:
+            urllib.request.urlopen(f"{base}/other", timeout=30)
+            raise AssertionError("expected HTTP 404 for /other")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404

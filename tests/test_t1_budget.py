@@ -1,0 +1,100 @@
+"""The tier-1 wall-time budget guard (tools/t1_budget.py): the 870 s
+tier-1 run truncates, so a single runaway non-slow test silently costs
+tail coverage — the guard must fail loudly on one, honor the slow
+marker, and never treat a missing durations file as a pass."""
+
+import importlib.util
+import json
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+_spec = importlib.util.spec_from_file_location(
+    "t1_budget", REPO / "tools" / "t1_budget.py"
+)
+t1_budget = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(t1_budget)
+
+
+def _durations_file(tmp_path, entries):
+    path = tmp_path / "durations.json"
+    path.write_text(json.dumps({"durations": entries}))
+    return str(path)
+
+
+def _entry(test, duration_s, slow=False):
+    return {
+        "test": test, "duration_s": duration_s, "slow": slow,
+        "outcome": "passed",
+    }
+
+
+def test_within_budget_passes(tmp_path, capsys):
+    path = _durations_file(tmp_path, [
+        _entry("tests/test_a.py::test_fast", 0.5),
+        _entry("tests/test_b.py::test_medium", 12.0),
+    ])
+    assert t1_budget.main(["--file", path]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["t1_budget"] == "ok"
+    assert rep["tests"] == 2
+
+
+def test_over_budget_non_slow_fails_loud(tmp_path, capsys):
+    path = _durations_file(tmp_path, [
+        _entry("tests/test_a.py::test_fast", 0.5),
+        _entry("tests/test_b.py::test_runaway", 45.0),
+    ])
+    assert t1_budget.main(["--file", path]) == 1
+    err = capsys.readouterr().err
+    assert "OVER BUDGET" in err and "test_runaway" in err
+
+
+def test_slow_marker_exempts(tmp_path):
+    path = _durations_file(tmp_path, [
+        _entry("tests/test_e2e.py::test_big", 120.0, slow=True),
+    ])
+    assert t1_budget.main(["--file", path]) == 0
+
+
+def test_custom_budget(tmp_path):
+    path = _durations_file(tmp_path, [
+        _entry("tests/test_b.py::test_medium", 12.0),
+    ])
+    assert t1_budget.main(["--file", path, "--budget", "10"]) == 1
+    assert t1_budget.main(["--file", path, "--budget", "15"]) == 0
+
+
+def test_missing_file_is_not_a_pass(tmp_path):
+    assert t1_budget.main(["--file", str(tmp_path / "nope.json")]) == 2
+
+
+def test_unreadable_file_is_not_a_pass(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text("{not json")
+    assert t1_budget.main(["--file", str(path)]) == 2
+
+
+def test_check_partition_semantics():
+    entries = [
+        _entry("a", 40.0),
+        _entry("b", 35.0, slow=True),
+        _entry("c", 1.0),
+    ]
+    over, slowest = t1_budget.check(entries, 30.0)
+    assert [e["test"] for e in over] == ["a"]
+    assert slowest[0]["test"] == "a"
+
+
+def test_conftest_wrote_this_sessions_durations():
+    """The producing half: conftest's logreport hook is accumulating
+    THIS session's durations (the file itself lands at session end)."""
+    import conftest
+    import pytest
+
+    if not conftest._t1_durations:
+        pytest.skip("this test ran first in the session: nothing recorded yet")
+    assert any(
+        e["test"].startswith("tests/") and "duration_s" in e and "slow" in e
+        for e in conftest._t1_durations
+    )

@@ -24,7 +24,11 @@ What counts as a headline metric (see BASELINE.md for meanings):
   lower is better; the span counts are structure, not latency, and are
   skipped),
 * ``extras.device_profile.device_occupancy_pct`` (HIGHER is better —
-  falling occupancy at equal work means growing dispatch gaps).
+  falling occupancy at equal work means growing dispatch gaps),
+* ``extras.host_profile.sampler_overhead_pct`` — judged against an
+  ABSOLUTE 2% ceiling on the latest round (the continuous-profiling
+  cost contract: the sampler must stay under 2% of the leg wall it
+  measures), never against best-so-far.
 
 Rounds whose ``parsed`` is null (a crashed bench run) contribute no
 values; they are counted and reported, never treated as zeros.
@@ -67,6 +71,14 @@ TOLERANCE_OVERRIDE = {
     "lint_stats.wall_ms": 1.00,
 }
 
+# metrics judged against an ABSOLUTE ceiling on the LATEST round only
+# (no best-so-far comparison: the host sampler's overhead budget is a
+# contract — "continuous profiling costs under 2% of the work it
+# measures" — not a trajectory to trend)
+ABSOLUTE_CEILING = {
+    "host_profile.sampler_overhead_pct": 2.0,
+}
+
 
 def _flat_headlines(parsed: dict):
     """Yield (metric, value, higher_is_better) from one round's parsed
@@ -103,6 +115,13 @@ def _flat_headlines(parsed: dict):
             occ = val.get("device_occupancy_pct")
             if isinstance(occ, (int, float)) and not isinstance(occ, bool):
                 yield "device_profile.device_occupancy_pct", float(occ), True
+        elif key == "host_profile" and isinstance(val, dict):
+            # continuous-profiling cost: judged against the 2% absolute
+            # ceiling (ABSOLUTE_CEILING), not best-so-far — a lucky
+            # 0.1% round must not turn every later 0.5% into a failure
+            ov = val.get("sampler_overhead_pct")
+            if isinstance(ov, (int, float)) and not isinstance(ov, bool):
+                yield "host_profile.sampler_overhead_pct", float(ov), False
         elif key == "lint_stats" and isinstance(val, dict):
             # celint whole-tree wall time: the R6 whole-program pass is
             # the only tier-1 gate whose cost grows with the TREE, so
@@ -143,6 +162,28 @@ def check(rounds, tolerance: float):
     summary = {}
     for metric, points in sorted(series.items()):
         *earlier, (last_round, last, higher) = points
+        ceiling = ABSOLUTE_CEILING.get(metric)
+        if ceiling is not None:
+            # absolute-budget metric: the latest round alone decides
+            summary[metric] = {
+                "last": last, "last_round": last_round,
+                "ceiling": ceiling,
+                "ratio": round(last / ceiling, 3) if ceiling else 1.0,
+            }
+            if last > ceiling:
+                regressions.append(
+                    {
+                        "metric": metric,
+                        "direction": "ceiling",
+                        "best": ceiling,
+                        "best_round": "(absolute ceiling)",
+                        "last": last,
+                        "last_round": last_round,
+                        "ratio": round(last / ceiling, 3),
+                        "tolerance": 0.0,
+                    }
+                )
+            continue
         if not earlier:
             summary[metric] = {
                 "last": last, "last_round": last_round,

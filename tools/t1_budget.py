@@ -1,0 +1,109 @@
+"""t1_budget: the tier-1 wall-time budget guard.
+
+The 870 s tier-1 run TRUNCATES — every second one test burns is a test
+at the tail that never executes, and a single runaway test silently
+shrinks the whole suite's coverage.  This tool reads the per-test
+duration table ``tests/conftest.py`` writes at session end (the same
+run that printed the 10-slowest report) and fails LOUDLY when any
+single non-``slow``-marked test exceeded its budget (default 30 s).
+
+Usage:
+    # after any tier-1 run (conftest wrote the durations file):
+    python tools/t1_budget.py
+    # explicit file / budget:
+    python tools/t1_budget.py --file /tmp/durations.json --budget 30
+
+The durations file location follows conftest: the
+``CELESTIA_TPU_T1_DURATIONS`` env var, else
+``<tempdir>/celestia_tpu_t1_durations.json``.
+
+Exit codes: 0 all within budget, 1 at least one test over budget,
+2 no durations file (run the suite first — a missing file must never
+read as "within budget").
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+DEFAULT_BUDGET_S = 30.0
+
+
+def default_path() -> str:
+    return os.environ.get("CELESTIA_TPU_T1_DURATIONS", "").strip() or (
+        os.path.join(tempfile.gettempdir(), "celestia_tpu_t1_durations.json")
+    )
+
+
+def check(entries, budget_s: float):
+    """Partition the duration table: (over-budget non-slow tests,
+    slowest 10 overall)."""
+    over = [
+        e
+        for e in entries
+        if not e.get("slow") and float(e.get("duration_s", 0.0)) > budget_s
+    ]
+    over.sort(key=lambda e: -float(e["duration_s"]))
+    slowest = sorted(
+        entries, key=lambda e: -float(e.get("duration_s", 0.0))
+    )[:10]
+    return over, slowest
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="t1_budget")
+    p.add_argument("--file", default=None,
+                   help="durations JSON written by tests/conftest.py "
+                        "(default: CELESTIA_TPU_T1_DURATIONS or the "
+                        "tempdir file)")
+    p.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S,
+                   help="per-test wall budget in seconds for non-slow "
+                        "tests (default 30)")
+    args = p.parse_args(argv)
+    path = args.file or default_path()
+    if not os.path.isfile(path):
+        print(
+            f"t1_budget: no durations file at {path} — run the tier-1 "
+            "suite first (conftest writes it at session end)",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        entries = doc["durations"]
+    except (OSError, ValueError, KeyError) as e:
+        print(f"t1_budget: unreadable durations file {path}: {e}",
+              file=sys.stderr)
+        return 2
+    over, slowest = check(entries, args.budget)
+    if over:
+        for e in over:
+            print(
+                "t1_budget: OVER BUDGET %.2fs > %.0fs: %s  "
+                "(mark it slow or make it cheap — the 870 s tier-1 run "
+                "truncates)"
+                % (float(e["duration_s"]), args.budget, e.get("test", "?")),
+                file=sys.stderr,
+            )
+        return 1
+    print(
+        json.dumps(
+            {
+                "t1_budget": "ok",
+                "tests": len(entries),
+                "budget_s": args.budget,
+                "slowest": [
+                    {"test": e.get("test"), "duration_s": e.get("duration_s")}
+                    for e in slowest[:5]
+                ],
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,7 @@ PY ?= python
 # passes --format through; exit codes are unchanged either way
 LINT_FORMAT ?=
 
-.PHONY: lint lockwatch test chaos trace-smoke profile-smoke incident-smoke t1-budget bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
+.PHONY: lint lockwatch test chaos trace-smoke profile-smoke incident-smoke multichip-smoke mesh-live t1-budget bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
 
 ## celint: concurrency & determinism static analysis (exit 1 on findings)
 lint:
@@ -61,6 +61,24 @@ profile-smoke:
 ## assertions via tests/test_incident_smoke.py)
 incident-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/incident_smoke.py
+
+## live mesh-path boot gate: a forced-multi-host-device subprocess
+## drives one real block through prepare->process with the sharded
+## extension wired in (CELESTIA_TPU_MESH) and asserts the merged trace
+## carries the sharded dispatch span on >= 2 distinct per-chip device
+## tracks and that the EDS cache served the process leg warm
+multichip-smoke:
+	$(PY) tools/multichip_smoke.py
+
+## full live mesh-path suite (slow tier: each subprocess child pays one
+## ~35-60 s structure-bound XLA CPU shard_map compile, over the 30 s
+## tier-1 budget): live prepare->process byte-identity vs the
+## single-device path, EDS-cache interop both directions, laundering
+## rejection, divisibility fallback and the degradation ladder on a
+## pure-row mesh, plus batched-vs-loop root equality and the warm-only
+## state-sync leg on a data x row mesh
+mesh-live:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_mesh_live.py -q -p no:cacheprovider
 
 ## tier-1 wall-time budget guard: judges the per-test durations file
 ## the last pytest session wrote (conftest) — fails loudly when any

@@ -164,6 +164,17 @@ def cmd_start(args) -> int:
         tracing.enable(trace_blocks)
         log.info("block tracing enabled", blocks=tracing.TRACER.max_blocks)
 
+    if getattr(args, "mesh", None) is not None:
+        # multi-chip mesh override (parallel/mesh.py): validated HERE so
+        # a malformed spec fails the start loudly instead of poisoning
+        # the mesh at the first block
+        from celestia_tpu.parallel import mesh as mesh_mod
+
+        try:
+            mesh_mod.configure(args.mesh)
+        except ValueError as e:
+            raise SystemExit(f"--mesh: {e}")
+
     genesis_path = Path(home) / "config" / "genesis.json"
     if not genesis_path.exists():
         raise SystemExit(f"no genesis at {genesis_path}; run `init` first")
@@ -304,6 +315,69 @@ def cmd_start(args) -> int:
             sizes=",".join(map(str, warm_sizes)),
             seconds=round(time.time() - t_warm, 1),
         )
+        # the warm-up already initialized the backend, so resolving the
+        # mesh here is free — the operator sees at boot whether live
+        # extends will shard (lazy resolution at the first block is the
+        # fallback when warm-up was skipped)
+        from celestia_tpu.parallel import mesh as mesh_mod
+
+        if mesh_mod.device_mesh() is not None:
+            shape = mesh_mod.mesh_shape()
+            log.info(
+                "multi-chip mesh active",
+                data=shape[0], row=shape[1],
+            )
+            # warm the SHARDED programs too: the live path routes
+            # through them on a mesh-active node, so without this the
+            # first real block would pay the structure-bound shard_map
+            # compile in the hot path — exactly the stall --warm-squares
+            # exists to prevent
+            from celestia_tpu.parallel import sharded as _sharded
+
+            t_warm = time.time()
+            warmed_sharded = []
+            # mesh-eligible subset of the warm sizes; when NONE is
+            # eligible (default '1,2,4' vs a wide row axis — every size
+            # falls back) warm the smallest eligible size instead, so at
+            # least one sharded program + the collective machinery
+            # compiles at boot rather than inside the first big block
+            # (operators size --warm-squares up for full coverage)
+            shard_sizes = [
+                s for s in warm_sizes
+                if mesh_mod.mesh_for_square(s, count_fallback=False)
+                is not None
+            ]
+            if not shard_sizes:
+                row = shape[1]
+                if row <= 128:
+                    shard_sizes = [row]
+            try:
+                for s in shard_sizes:
+                    m = mesh_mod.mesh_for_square(s, count_fallback=False)
+                    if m is None:
+                        continue
+                    _sharded.extend_and_roots_sharded(
+                        _np.zeros((s, s, 512), dtype=_np.uint8), m,
+                        record_stats=False,
+                    )
+                    warmed_sharded.append(s)
+            except Exception as e:
+                # the same failure one block later would merely poison
+                # the mesh and serve single-device — boot must degrade
+                # identically, never exit
+                mesh_mod.poison(f"sharded warm-up failed: {e!r}")
+                log.warn(
+                    "multi-chip mesh disabled",
+                    reason=mesh_mod.poisoned(),
+                )
+            if warmed_sharded:
+                log.info(
+                    "sharded device programs warmed",
+                    sizes=",".join(map(str, warmed_sharded)),
+                    seconds=round(time.time() - t_warm, 1),
+                )
+        elif mesh_mod.poisoned():
+            log.warn("multi-chip mesh disabled", reason=mesh_mod.poisoned())
     device_profile_dir = None
     # CELESTIA_TPU_DEVICE_PROFILE is the env equivalent of the flag
     # (same contract as CELESTIA_TPU_TRACE): the flag wins when both
@@ -1477,7 +1551,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument(
         "--warm-squares", default="1,2,4",
         help="square sizes whose device programs compile at boot instead "
-             "of stalling the first live block ('' disables)",
+             "of stalling the first live block ('' disables); on a "
+             "mesh-active node the mesh-eligible sizes also warm the "
+             "sharded programs (size up, e.g. 64,128, for full coverage)",
+    )
+    sp.add_argument(
+        "--mesh", default=None, metavar="SPEC",
+        help="multi-chip mesh factoring for the sharded extension path: "
+             "'DATAxROW' (e.g. 2x4), 'auto' (default: all devices on the "
+             "row axis when >1 accelerator is visible), or 'off' "
+             "(CELESTIA_TPU_MESH is equivalent; the flag wins)",
     )
     sp.add_argument(
         "--trace", action="store_true",

@@ -210,6 +210,7 @@ class GossipEngine:
         max_peers: int = 64,
         pex_interval_s: float = 1.0,
         chunk_retry_deadline_s: float = 10.0,
+        catchup_batch: Optional[int] = None,
         logger=None,
     ):
         self.node = node
@@ -235,6 +236,19 @@ class GossipEngine:
             failures_to_open=1, cooldown_s=10.0
         )
         self.chunk_retry_deadline_s = chunk_retry_deadline_s
+        # decided blocks adopted per batched catch-up step: the window's
+        # same-k extends run as ONE mesh dispatch (BASELINE config #5 —
+        # node.bft_catchup_batch); 1 restores the per-block behavior.
+        # Default sized to the warmable EDS-cache budget (max_entries
+        # minus the reserved min-DAH slot) so a full window's warm never
+        # truncates — a window one larger would pay a per-block extend
+        # for its last block on EVERY step and fire the truncation
+        # telemetry continuously during normal catch-up
+        if catchup_batch is None:
+            from celestia_tpu.da import eds_cache
+
+            catchup_batch = min(8, eds_cache.CACHE.max_entries - 1)
+        self.catchup_batch = max(1, catchup_batch)
         # drops from links that no longer exist (evicted peers) — keeps
         # dropped_total monotonic for monitoring deltas
         self._dropped_closed = 0
@@ -741,8 +755,29 @@ class GossipEngine:
                 continue
             try:
                 while self.node.height < target:
-                    d = self._pull_rpc(cli.bft_decided, self.node.height + 1)
-                    if d is None:
+                    # pull a WINDOW of decided blocks, then adopt them in
+                    # one batched step: the window's same-k extends run
+                    # as one mesh dispatch instead of one per block
+                    # (testnode.bft_catchup_batch; the RPCs stay one per
+                    # block — the device dispatch is what batches)
+                    wires = []
+                    lo = self.node.height + 1
+                    hi = min(target, lo + self.catchup_batch - 1)
+                    for h in range(lo, hi + 1):
+                        try:
+                            d = self._pull_rpc(cli.bft_decided, h)
+                        except Exception:
+                            if not wires:
+                                raise  # same failure path as per-block
+                            # a mid-window RPC failure must not discard
+                            # the wires already pulled: adopt the
+                            # partial window; the next window (or the
+                            # empty-window raise above) retries h
+                            break
+                        if d is None:
+                            break
+                        wires.append(d)
+                    if not wires:
                         # the peer has pruned past our height: a node
                         # offline longer than the decided-log window
                         # state-syncs from a served snapshot, then
@@ -750,7 +785,8 @@ class GossipEngine:
                         if not self._try_state_sync(cli, addr):
                             break
                         continue
-                    if not self.node.bft_catchup(d)[0]:
+                    adopted, _why = self.node.bft_catchup_batch(wires)
+                    if adopted < len(wires):
                         break
             except Exception as e:
                 faults.note("gossip.fetch", e)

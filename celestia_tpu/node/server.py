@@ -382,6 +382,29 @@ class NodeService:
             lines.append(
                 f"celestia_tpu_flight_incidents_kept {fst['incidents_kept']}"
             )
+        # multi-chip mesh plane (parallel/mesh.py): whether live extends
+        # shard, how many have, and how many squares fell back — a
+        # degraded (poisoned) mesh shows as active 0 with extends frozen
+        from celestia_tpu.parallel import mesh as mesh_mod
+
+        ms = mesh_mod.stats()
+        lines.append(
+            f"celestia_tpu_mesh_active {1 if ms['active'] else 0}"
+        )
+        lines.append(
+            "# TYPE celestia_tpu_mesh_sharded_extends_total counter"
+        )
+        lines.append(
+            f"celestia_tpu_mesh_sharded_extends_total "
+            f"{ms['sharded_extends']}"
+        )
+        lines.append(
+            "# TYPE celestia_tpu_mesh_fallback_squares_total counter"
+        )
+        lines.append(
+            f"celestia_tpu_mesh_fallback_squares_total "
+            f"{ms['fallback_squares']}"
+        )
         # trace-ring health (satellite: remote truncation detectability)
         rs = tracing.ring_stats()
         lines.append(

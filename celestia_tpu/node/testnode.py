@@ -406,11 +406,112 @@ class TestNode:
                 return False, "BFT mode not enabled"
             payload = BlockPayload.from_wire(decided_wire["payload"])
             if payload.height != self.height + 1:
+                # height-guard BEFORE precommit parsing: a stale
+                # (already-adopted) wire with junk precommits stays a
+                # benign duplicate instead of raising mid-catch-up
                 return payload.height <= self.height, "not the next height"
             precommits = [
                 Vote.from_wire(v) for v in decided_wire["precommits"]
             ]
-            return self._bft.adopt_decision(payload, precommits)
+            return self._adopt_parsed(payload, precommits)
+
+    def _adopt_parsed(self, payload, precommits) -> Tuple[bool, str]:
+        """Shared adoption tail (caller holds the service lock and has
+        already deserialized the wire)."""
+        if payload.height != self.height + 1:
+            return payload.height <= self.height, "not the next height"
+        return self._bft.adopt_decision(payload, precommits)
+
+    def bft_catchup_batch(self, decided_wires: List[dict]) -> Tuple[int, str]:
+        """Adopt a WINDOW of externally-replayed decided blocks: the
+        state-independent extends of every same-k square in the window
+        run as ONE batched mesh dispatch (App.validate_blocks_batched
+        warm-only leg, parallel/sharded.extend_and_roots_sharded_batch),
+        then each block adopts sequentially through the unchanged
+        certificate-verified path — adopt_decision's per-block
+        validation (ante, signatures, strict reconstruction, root
+        compare) runs against the then-current state and simply hits the
+        warm EDS cache on its extend leg.  Trust is untouched: nothing
+        is adopted that bft_catchup would not have adopted one at a
+        time.  Returns (blocks adopted, reason for the first failed
+        adoption verdict or "").  A MALFORMED wire re-raises its parse
+        error AFTER the intact prefix has been adopted — the same
+        penalty path per-block replay took (the gossip caller's outer
+        except drops the serving peer and records a breaker failure;
+        swallowing it would leave a peer persistently serving junk
+        breaker-healthy and re-pulled forever)."""
+        from celestia_tpu.node.bft import BlockPayload, Vote
+        from celestia_tpu.utils import faults
+
+        if self._bft is None:
+            return 0, "BFT mode not enabled"
+        # parse + warm OUTSIDE the service lock: parsing is pure and
+        # the warm leg only touches thread-safe surfaces (EDS cache,
+        # mesh provider, telemetry) — a cold batched shard_map compile
+        # here must not stall every RPC the node serves behind the lock
+        parsed = []
+        parse_exc = None
+        for w in decided_wires:
+            try:
+                parsed.append(
+                    (
+                        BlockPayload.from_wire(w["payload"]),
+                        [Vote.from_wire(v) for v in w["precommits"]],
+                    )
+                )
+            except Exception as e:
+                faults.note("gossip.catchup_batch", e)
+                parse_exc = e
+                break  # adopt the intact prefix, then re-raise
+        # warm keys are stamped with the CURRENT app_version, so a
+        # window straddling the predictable v1->v2 upgrade height
+        # warms only the pre-upgrade prefix — post-upgrade blocks
+        # would validate under v2 keys and miss every warmed entry
+        # (signal-based v2+ upgrades can't be foreseen; those
+        # blocks just degrade to the per-block extend path)
+        warmable = parsed
+        if (
+            self.app.app_version == 1
+            and self.app.v2_upgrade_height is not None
+        ):
+            warmable = [
+                (p, pc)
+                for p, pc in parsed
+                if p.height < self.app.v2_upgrade_height
+            ]
+        if len(warmable) > 1:
+            try:
+                self.app.validate_blocks_batched(
+                    [
+                        (list(p.txs), p.square_size, p.data_root)
+                        for p, _pc in warmable
+                    ],
+                    warm_only=True,
+                )
+            except Exception as e:
+                # warming is an optimization: a failure degrades to
+                # the per-block extends, never blocks adoption
+                faults.note("gossip.catchup_batch", e)
+        # lock PER BLOCK, as the replaced per-block loop did: a window
+        # of full validations (signatures + strict reconstruction +
+        # commit, hundreds of ms each) must not stall every RPC behind
+        # one continuous hold — _adopt_parsed's height check makes
+        # interleaved adoptions (another catch-up source, live commits)
+        # benign duplicates, not corruption
+        adopted = 0
+        for payload, precommits in parsed:
+            with self._service_lock:
+                if self._bft is None:
+                    return adopted, "BFT mode not enabled"
+                ok, why = self._adopt_parsed(payload, precommits)
+            if not ok:
+                return adopted, why
+            adopted += 1
+        if parse_exc is not None:
+            # the prefix's progress is already committed to state;
+            # the junk wire still penalizes the peer that served it
+            raise parse_exc
+        return adopted, ""
 
     def verify_state_sync_anchor(
         self, meta: dict, decided_wire: dict

@@ -1,0 +1,303 @@
+"""Mesh provider: makes the multi-chip mesh the default device backend.
+
+The sharded extension pipeline (parallel/sharded.py) has been proven
+byte-identical to the single-device path since MULTICHIP_r01, but only
+as a dryrun.  This module is the missing policy layer that decides, once
+per process, whether the LIVE proposal lifecycle should dispatch through
+it:
+
+* **Discovery.**  At first use the provider inspects the jax backend.
+  More than one accelerator device visible ⇒ the mesh is ON by default
+  (the ROADMAP tentpole: "make the mesh the default device backend when
+  >1 device is visible").  A CPU backend auto-resolves to OFF — the
+  host regime's pooled native pipeline (da/dah.py) is the proven fast
+  path there, and XLA's *forced* host devices
+  (``--xla_force_host_platform_device_count``) are virtual slices of
+  one physical CPU, so auto-sharding over them buys nothing.  Tests and
+  smokes opt the virtual mesh in with an explicit spec.
+* **Factoring.**  The mesh axes are ``data x row`` (multi-square batch
+  x intra-square row sharding).  Auto picks ``1 x R`` with R the
+  largest power of two ≤ the device count: the live path's dominant
+  workload is ONE square per block, so all chips go to the row axis
+  (rows of a power-of-two square always divide a power-of-two R ≤ k).
+  Operators override with ``CELESTIA_TPU_MESH`` / ``--mesh`` —
+  ``"2x4"`` (data x row), ``"auto"``, or ``"off"``.  An explicit
+  factoring also forces the mesh ON over a CPU backend (how the tier-1
+  mesh tests and `make multichip-smoke` engage the virtual 8-device
+  mesh).
+* **Per-square fallback.**  :func:`mesh_for_square` returns the mesh
+  only when the square's rows divide the row axis (``k % R == 0`` and
+  ``k >= R``); otherwise the caller falls back to the single-device
+  path — tiny/empty squares (the min-DAH's k=1) never pay a mesh
+  dispatch.  Fallbacks are counted (:func:`stats`).
+* **Degradation ladder** (specs/robustness.md): a sharded dispatch
+  failure mid-flight calls :func:`poison` — a one-way pin to the
+  single-device path for the rest of the process (the same contract as
+  utils/native.py's poison), loud in stats and telemetry, cleared only
+  by ``clear_poison(force=True)`` (tests/operator).  A malformed mesh
+  spec poisons at resolution instead of raising on the block hot path.
+
+Layering (celint R8): parallel sits between da and state — state/app.py
+imports this module (forward edge), da never does.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Tuple
+
+ENV_MESH = "CELESTIA_TPU_MESH"
+
+_OFF_SPECS = ("off", "none", "0", "false", "no", "single")
+_AUTO_SPECS = ("", "auto", "on", "1", "true", "yes")
+
+_lock = threading.Lock()
+# serializes FIRST-USE resolution only (ordered before _lock): without
+# it two racing first callers each build a distinct Mesh object, and
+# since the sharded program cache keys on the Mesh instance, every
+# program would compile twice and the threads would forever key
+# different cache entries for identical programs
+_resolve_lock = threading.Lock()
+_configured: Optional[str] = None  # --mesh override; celint: guarded-by(_lock)
+# resolved (mesh, data, row) or None, plus a "was resolved" flag so a
+# None result is cached too; celint: guarded-by(_lock)
+_resolved: Optional[Tuple[object, int, int]] = None
+_resolved_done = False  # celint: guarded-by(_lock)
+_poison_reason: Optional[str] = None  # celint: guarded-by(_lock)
+_fallback_k: int = 0  # squares routed single-device (k % row != 0)
+_sharded_extends: int = 0  # squares routed through the mesh
+_batched_dispatches: int = 0  # batched multi-square dispatches
+
+
+def parse_spec(spec: str) -> Optional[Tuple[int, int]]:
+    """``"DxR"`` -> (data, row); ``"off"``-family -> (0, 0) sentinel;
+    ``"auto"``-family -> None.  Raises ValueError on garbage."""
+    s = str(spec).strip().lower()
+    if s in _AUTO_SPECS:
+        return None
+    if s in _OFF_SPECS:
+        return (0, 0)
+    parts = s.split("x")
+    if len(parts) != 2 or not all(p.isdigit() for p in parts):
+        raise ValueError(
+            f"mesh spec must be 'DATAxROW' (e.g. 2x4), 'auto' or 'off'; "
+            f"got {spec!r}"
+        )
+    data, row = int(parts[0]), int(parts[1])
+    if data < 1 or row < 1:
+        raise ValueError(f"mesh axes must be >= 1, got {spec!r}")
+    return (data, row)
+
+
+def configure(spec: Optional[str]) -> None:
+    """CLI override (``start --mesh``): validated eagerly (raises on a
+    malformed spec — startup is the loud place), cached resolution is
+    dropped so the next use re-resolves."""
+    global _configured, _resolved, _resolved_done
+    if spec is not None:
+        parse_spec(spec)  # raise here, not on the block hot path
+    with _lock:
+        _configured = spec
+        _resolved = None
+        _resolved_done = False
+
+
+def poison(reason: str) -> None:
+    """One-way pin to the single-device path (first reason wins — the
+    original fault must not be overwritten by knock-on failures)."""
+    global _poison_reason
+    from celestia_tpu.utils import faults
+
+    with _lock:
+        if _poison_reason is not None:
+            return
+        _poison_reason = reason
+    faults.record_degradation("mesh", reason)
+
+
+def poisoned() -> Optional[str]:
+    with _lock:
+        return _poison_reason
+
+
+def clear_poison(force: bool = False) -> None:
+    """Tests/operator intervention only: the pin is one-way by contract."""
+    global _poison_reason, _resolved, _resolved_done
+    if not force:
+        raise RuntimeError(
+            "mesh poison is a one-way degradation pin; pass force=True "
+            "only from tests or deliberate operator intervention"
+        )
+    with _lock:
+        _poison_reason = None
+        _resolved = None
+        _resolved_done = False
+
+
+def _auto_factoring() -> Optional[Tuple[int, int]]:
+    """Default policy: all devices on the row axis, none on data.
+    None when the mesh should stay off (CPU backend / single device)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return None
+    n = int(jax.local_device_count())
+    if n < 2:
+        return None
+    row = 1
+    while row * 2 <= n:
+        row *= 2
+    return (1, row)
+
+
+def _resolve():
+    """Build (mesh, data, row) or None from spec/env/auto.  Runs with NO
+    lock held (jax backend init can be slow); the caller caches the
+    result under the module lock."""
+    spec = _configured
+    if spec is None:
+        spec = os.environ.get(ENV_MESH, "")
+    try:
+        factoring = parse_spec(spec)
+    except ValueError as e:
+        poison(f"malformed mesh spec: {e}")
+        return None
+    explicit = factoring is not None and factoring != (0, 0)
+    if factoring == (0, 0):
+        return None
+    if factoring is None:
+        factoring = _auto_factoring()
+    if factoring is None:
+        return None
+    data, row = factoring
+    import jax
+
+    # process-LOCAL devices, matching _auto_factoring's count: on a
+    # multi-host backend each process meshes over its own chips —
+    # jax.devices() would hand every host the global list and host 1
+    # would device_put onto chips it does not own
+    devices = jax.local_devices()
+    if data * row > len(devices):
+        poison(
+            f"mesh spec {data}x{row} needs {data * row} devices, "
+            f"{len(devices)} visible"
+        )
+        return None
+    if not explicit and len(devices) < 2:
+        return None
+    from celestia_tpu.parallel.sharded import make_mesh
+
+    mesh = make_mesh(devices[: data * row], data=data, row=row)
+    return (mesh, data, row)
+
+
+def device_mesh():
+    """The process mesh, or None (single-device path).  Resolved once;
+    ``configure``/``clear_poison(force=True)`` drop the cache."""
+    global _resolved, _resolved_done
+    with _lock:
+        if _poison_reason is not None:
+            return None
+        if _resolved_done:
+            return _resolved[0] if _resolved is not None else None
+    with _resolve_lock:
+        # double-check: the race loser reuses the winner's Mesh instead
+        # of building (and later compiling against) its own
+        with _lock:
+            if _poison_reason is not None:
+                return None
+            if _resolved_done:
+                return _resolved[0] if _resolved is not None else None
+        try:
+            resolved = _resolve()
+        except Exception as e:  # backend init failure: degrade, never raise
+            poison(f"mesh resolution failed: {e!r}")
+            resolved = None
+        with _lock:
+            if _poison_reason is not None:
+                return None
+            _resolved = resolved
+            _resolved_done = True
+            return resolved[0] if resolved is not None else None
+
+
+def mesh_shape() -> Optional[Tuple[int, int]]:
+    """(data, row) of the active mesh, or None."""
+    if device_mesh() is None:
+        return None
+    with _lock:
+        return (_resolved[1], _resolved[2]) if _resolved is not None else None
+
+
+def mesh_for_square(k: int, count_fallback: bool = True):
+    """The mesh when square size ``k`` can shard over the row axis
+    (``k % row == 0`` and ``k >= row``), else None — the per-square
+    clean fallback to the single-device path.  ``count_fallback=False``
+    keeps group-level probes (mesh_for_batch) out of the per-SQUARE
+    fallback counter — each square in a fallen-back group is counted
+    once, on its own routing."""
+    global _fallback_k
+    mesh = device_mesh()
+    if mesh is None:
+        return None
+    row = int(mesh.shape["row"])
+    if k < row or k % row:
+        if count_fallback:
+            with _lock:
+                _fallback_k += 1
+        return None
+    return mesh
+
+
+def mesh_for_batch(k: int, n: int):
+    """The mesh when a batch of ``n`` same-k squares can run the batched
+    leg: the square shards over ``row`` and the batch is non-empty (the
+    batch is padded to a multiple of the ``data`` axis by the caller)."""
+    if n < 1:
+        return None
+    return mesh_for_square(k, count_fallback=False)
+
+
+def record_sharded_extend(batched: bool = False, squares: int = 1) -> None:
+    """Bookkeeping from the sharded entries (parallel/sharded.py)."""
+    global _sharded_extends, _batched_dispatches
+    with _lock:
+        _sharded_extends += squares
+        if batched:
+            _batched_dispatches += 1
+
+
+def stats() -> dict:
+    """Operational snapshot (status RPC / exposition / tests)."""
+    with _lock:
+        resolved = _resolved
+        out = {
+            "configured": _configured,
+            "env": os.environ.get(ENV_MESH, ""),
+            "resolved": _resolved_done,
+            "active": resolved is not None and _poison_reason is None,
+            "poisoned": _poison_reason,
+            "fallback_squares": _fallback_k,
+            "sharded_extends": _sharded_extends,
+            "batched_dispatches": _batched_dispatches,
+        }
+        if resolved is not None:
+            out["data"] = resolved[1]
+            out["row"] = resolved[2]
+        return out
+
+
+def _reset_for_tests() -> None:
+    """Drop ALL provider state (tests only — the provider is pin-once
+    per process by design)."""
+    global _configured, _resolved, _resolved_done, _poison_reason
+    global _fallback_k, _sharded_extends, _batched_dispatches
+    with _lock:
+        _configured = None
+        _resolved = None
+        _resolved_done = False
+        _poison_reason = None
+        _fallback_k = 0
+        _sharded_extends = 0
+        _batched_dispatches = 0

@@ -26,7 +26,8 @@ Communication pattern (all XLA collectives over ICI):
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import partial
+from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +41,8 @@ from celestia_tpu.ops import rs
 from celestia_tpu.ops.gf256 import active_codec as _active_codec
 from celestia_tpu.ops.gf256 import encode_matrix_bits
 from celestia_tpu.ops.nmt import NMT_DIGEST_SIZE, _PARITY_NS
+from celestia_tpu.utils import devprof, tracing
+from celestia_tpu.utils.lru import LruCache
 
 
 def make_mesh(devices=None, data: int = 1, row: int = None) -> Mesh:
@@ -155,8 +158,14 @@ def _sharded_extend_and_roots(square_shard: jnp.ndarray, G: jnp.ndarray, k: int,
     return eds_local, row_roots, col_roots, data_root
 
 
-@lru_cache(maxsize=None)
-def _sharded_fn(mesh: Mesh, k: int, batched: bool, codec: str):
+# program-handle cache on the unified LRU (celint R2's sanctioned
+# surface): one jitted shard_map program per (mesh, k, batched, codec).
+# 64 entries cover every power-of-two k x 2 legs x a few factorings; an
+# eviction only costs a retrace, never wrong bytes.
+_FN_CACHE = LruCache("sharded_fns", 64)
+
+
+def _build_sharded_fn(mesh: Mesh, k: int, batched: bool, codec: str):
     R = mesh.shape["row"]
     if k % R:
         raise ValueError(f"square size {k} not divisible by row shards {R}")
@@ -189,6 +198,17 @@ def _sharded_fn(mesh: Mesh, k: int, batched: bool, codec: str):
     return jax.jit(fn)
 
 
+def _sharded_fn(mesh: Mesh, k: int, batched: bool, codec: str):
+    key = (mesh, k, batched, codec)
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        # built OUTSIDE the cache lock (encode_matrix_bits is real work);
+        # a racing double-build puts identical handles — last writer wins
+        fn = _build_sharded_fn(mesh, k, batched, codec)
+        _FN_CACHE.put(key, fn)
+    return fn
+
+
 def _reassemble_eds(eds_local: np.ndarray, k: int) -> np.ndarray:
     """(k, 2, 2k, B) row-shard layout -> (2k, 2k, B)."""
     top = eds_local[:, 0]  # (k, 2k, B)
@@ -196,27 +216,165 @@ def _reassemble_eds(eds_local: np.ndarray, k: int) -> np.ndarray:
     return np.concatenate([top, bot], axis=0)
 
 
-def extend_and_roots_sharded(square: np.ndarray, mesh: Mesh):
+def extend_and_roots_sharded(
+    square: np.ndarray, mesh: Mesh, *, record_stats: bool = True
+):
     """Sharded fused hot path on a mesh: square uint8[k,k,512] ->
-    (eds uint8[2k,2k,512], row_roots, col_roots, data_root)."""
+    (eds uint8[2k,2k,512], row_roots, col_roots, data_root).
+
+    Instrumented: an ``extend.sharded`` span with the mesh shape as args
+    (the live-path trace names the factoring) and a devprof dispatch
+    bracket that records the t1→t2 interval on EVERY chip the output is
+    sharded across — device occupancy across chips is a measured number
+    on the merged Perfetto timeline, not a guess.  ``record_stats=False``
+    keeps warm-up extends (cli boot) out of the mesh provider's
+    sharded-extends counter — the exposition reports LIVE extends."""
     square = np.asarray(square, dtype=np.uint8)
     k = square.shape[0]
-    sharding = NamedSharding(mesh, P("row", None, None))
-    x = jax.device_put(jnp.asarray(square), sharding)
-    eds_local, row_roots, col_roots, data_root = _sharded_fn(mesh, k, False, _active_codec())(x)
-    eds = _reassemble_eds(np.asarray(eds_local), k)
-    return eds, np.asarray(row_roots), np.asarray(col_roots), np.asarray(data_root)
+    codec = _active_codec()
+    data_ax, row_ax = int(mesh.shape["data"]), int(mesh.shape["row"])
+    with tracing.span(
+        "extend.sharded", k=k, mesh_data=data_ax, mesh_row=row_ax,
+        codec=codec,
+    ):
+        sharding = NamedSharding(mesh, P("row", None, None))
+        x = jax.device_put(jnp.asarray(square), sharding)
+        fn = _sharded_fn(mesh, k, False, codec)
+        d = devprof.dispatch(
+            "extend_sharded", multi_device=True,
+            k=k, mesh=f"{data_ax}x{row_ax}", codec=codec,
+        )
+        out = d.done(fn(x))
+        eds_local, row_roots, col_roots, data_root = out
+        eds = _reassemble_eds(np.asarray(eds_local), k)
+        result = (
+            eds,
+            np.asarray(row_roots),
+            np.asarray(col_roots),
+            np.asarray(data_root),
+        )
+    # cost accounting OUTSIDE the traced span (same placement contract
+    # as da/dah.py): the one-time AOT compile lands in the
+    # celestia_tpu_xla_* kernel table, never in the phase ms
+    devprof.note_compile("extend_sharded", fn, (x,))
+    if record_stats:
+        from celestia_tpu.parallel import mesh as mesh_mod
+
+        mesh_mod.record_sharded_extend()
+    return result
 
 
-def extend_and_roots_sharded_batch(squares: np.ndarray, mesh: Mesh):
+def extend_and_roots_sharded_batch(
+    squares: np.ndarray, mesh: Mesh, *, count_squares: int = None
+):
     """Batched sharded path: uint8[n, k, k, 512], n divisible by the data
     axis -> (eds[n,2k,2k,512], row_roots[n,2k,90], col_roots[n,2k,90],
-    data_roots[n,32])."""
+    data_roots[n,32]).  One device dispatch for the whole batch — the
+    state-sync catch-up leg (BASELINE.json config #5).
+
+    ``count_squares``: how many of the n inputs are REAL squares (the
+    rest are data-axis padding the caller will drop) — only the real
+    ones land in the mesh provider's sharded-extends counter."""
     squares = np.asarray(squares, dtype=np.uint8)
     n, k = squares.shape[0], squares.shape[1]
-    sharding = NamedSharding(mesh, P("data", "row", None, None))
-    x = jax.device_put(jnp.asarray(squares), sharding)
-    eds_local, row_roots, col_roots, data_roots = _sharded_fn(mesh, k, True, _active_codec())(x)
-    eds_local = np.asarray(eds_local)
-    eds = np.stack([_reassemble_eds(eds_local[i], k) for i in range(n)])
-    return eds, np.asarray(row_roots), np.asarray(col_roots), np.asarray(data_roots)
+    codec = _active_codec()
+    data_ax, row_ax = int(mesh.shape["data"]), int(mesh.shape["row"])
+    with tracing.span(
+        "extend.sharded", k=k, batch=n, mesh_data=data_ax,
+        mesh_row=row_ax, codec=codec,
+    ):
+        sharding = NamedSharding(mesh, P("data", "row", None, None))
+        x = jax.device_put(jnp.asarray(squares), sharding)
+        fn = _sharded_fn(mesh, k, True, codec)
+        d = devprof.dispatch(
+            "extend_sharded_batch", multi_device=True,
+            k=k, batch=n, mesh=f"{data_ax}x{row_ax}", codec=codec,
+        )
+        out = d.done(fn(x))
+        eds_local, row_roots, col_roots, data_roots = out
+        eds_local = np.asarray(eds_local)
+        eds = np.stack([_reassemble_eds(eds_local[i], k) for i in range(n)])
+        result = (
+            eds,
+            np.asarray(row_roots),
+            np.asarray(col_roots),
+            np.asarray(data_roots),
+        )
+    devprof.note_compile("extend_sharded_batch", fn, (x,))
+    from celestia_tpu.parallel import mesh as mesh_mod
+
+    mesh_mod.record_sharded_extend(
+        batched=True, squares=n if count_squares is None else count_squares
+    )
+    return result
+
+
+# ---------------------------------------------------------------------------
+# (EDS, DAH) entries for the live proposal lifecycle (state/app.py)
+# ---------------------------------------------------------------------------
+
+
+def _header_from_roots(row_roots: np.ndarray, col_roots: np.ndarray,
+                       data_root: np.ndarray):
+    """Fold sharded root arrays into a DataAvailabilityHeader whose hash
+    IS the replicated data root the mesh computed (cross-checked: the
+    sharded RFC-6962 fold and the host fold agree byte-for-byte per
+    tests/_sharded_isolated.py, so this trusts the device fold)."""
+    from celestia_tpu.da.dah import DataAvailabilityHeader
+
+    n2 = row_roots.shape[0]
+    return DataAvailabilityHeader(
+        tuple(row_roots[i].tobytes() for i in range(n2)),
+        tuple(col_roots[i].tobytes() for i in range(n2)),
+        np.asarray(data_root).tobytes(),
+    )
+
+
+def extend_and_header_sharded(square: np.ndarray, mesh: Mesh):
+    """The mesh twin of da/dah.extend_and_header: square uint8[k,k,512]
+    -> (ExtendedDataSquare, DataAvailabilityHeader), byte-identical to
+    the single-device path (the consensus-safety requirement)."""
+    from celestia_tpu.da.dah import ExtendedDataSquare
+
+    eds, row_roots, col_roots, data_root = extend_and_roots_sharded(
+        square, mesh
+    )
+    return ExtendedDataSquare(eds), _header_from_roots(
+        row_roots, col_roots, data_root
+    )
+
+
+def extend_block_sharded(square, mesh: Mesh):
+    """The mesh twin of da/dah.extend_block: a da.square.Square in, one
+    sharded dispatch, (EDS, DAH) out."""
+    k = square.size
+    arr = square.to_array().reshape(k, k, SHARE_SIZE)
+    return extend_and_header_sharded(arr, mesh)
+
+
+def extend_and_headers_sharded_batch(
+    squares: np.ndarray, mesh: Mesh, *, count_squares: int = None
+) -> List[Tuple[object, object]]:
+    """Batched (EDS, DAH) list for n same-k squares in ONE dispatch.
+
+    The caller pads the batch to a multiple of the ``data`` axis (the
+    shard_map leading dim must divide it) and drops the pad results; the
+    state-sync warm path (state/app.py warm_extends_batched) does both
+    and passes ``count_squares`` so pads never inflate the counter.
+    """
+    from celestia_tpu.da.dah import ExtendedDataSquare
+
+    eds, row_roots, col_roots, data_roots = extend_and_roots_sharded_batch(
+        squares, mesh, count_squares=count_squares
+    )
+    out: List[Tuple[object, object]] = []
+    for i in range(eds.shape[0]):
+        out.append(
+            (
+                ExtendedDataSquare(eds[i]),
+                _header_from_roots(
+                    row_roots[i], col_roots[i], data_roots[i]
+                ),
+            )
+        )
+    return out

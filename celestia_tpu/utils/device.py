@@ -39,6 +39,28 @@ def host_regime() -> bool:
     return _host_regime
 
 
+def force_host_devices_env(env: dict, n: int) -> dict:
+    """Prepare ``env`` (in place; also returned) so a CHILD process sees
+    an n-device virtual CPU mesh: pins JAX_PLATFORMS=cpu and sets or
+    REPLACES ``--xla_force_host_platform_device_count`` in XLA_FLAGS —
+    the flag only takes effect before jax initialises, which is why
+    every user of it re-execs (dryrun_multichip, the mesh smoke, the
+    bench multichip leg; this is the one shared copy of that dance)."""
+    import re
+
+    env["JAX_PLATFORMS"] = "cpu"
+    flag = f"--xla_force_host_platform_device_count={int(n)}"
+    xf = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in xf:
+        xf = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, xf
+        )
+    else:
+        xf = (xf + " " + flag).strip()
+    env["XLA_FLAGS"] = xf
+    return env
+
+
 def backend_available(
     timeout_s: float = 120.0, accept_cpu: bool = True
 ) -> bool:

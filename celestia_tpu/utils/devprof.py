@@ -234,6 +234,27 @@ def _device_of(out):
         return "unknown", 0, None
 
 
+def _devices_of_sharded(out):
+    """Every (platform, ordinal, dev) a sharded output spans, ordinal-
+    sorted, or None when no leaf exposes a sharding (single-device
+    arrays, host fallbacks).  Never raises — a platform that cannot
+    answer degrades to the single-device accounting."""
+    try:
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(out):
+            sh = getattr(leaf, "sharding", None)
+            ds = getattr(sh, "device_set", None)
+            if ds and len(ds) > 1:
+                return sorted(
+                    ((str(d.platform), int(d.id), d) for d in ds),
+                    key=lambda t: t[1],
+                )
+    except Exception as e:
+        note("devices_of_sharded", e)
+    return None
+
+
 def _sample_memory_of(dev) -> Optional[dict]:
     """memory_stats() of one device folded to the watermark dict, or
     None (CPU backends return None / raise — both degrade to a note).
@@ -285,13 +306,21 @@ class Dispatch:
     """One device dispatch bracket.  Construct BEFORE the jitted call
     (stamps enqueue start), call :meth:`done` with the call's result —
     it blocks until the device drains, records the device-track span and
-    the occupancy stats, and returns the result unchanged."""
+    the occupancy stats, and returns the result unchanged.
 
-    __slots__ = ("name", "args", "_t0", "_parent")
+    With ``multi=True`` (sharded dispatches — parallel/sharded.py) the
+    t1→t2 interval is recorded on EVERY chip the output is sharded
+    across: one device-track span and one busy contribution per chip.
+    All chips execute the collective program concurrently, so charging
+    the full interval to each is the same queue-wait-plus-execution
+    upper bound the single-device bracket records."""
 
-    def __init__(self, name: str, args: dict):
+    __slots__ = ("name", "args", "_t0", "_parent", "_multi")
+
+    def __init__(self, name: str, args: dict, multi: bool = False):
         self.name = name
         self.args = args
+        self._multi = multi
         self._parent = tracing.current()
         self._t0 = clock()
 
@@ -307,42 +336,57 @@ class Dispatch:
             note("block_until_ready", e)
             return out
         t2 = clock()
-        platform, ordinal, dev = _device_of(out)
-        key = f"{platform}:{ordinal}"
+        devices = _devices_of_sharded(out) if self._multi else None
+        if not devices:
+            devices = [_device_of(out)]
         busy = max(0.0, t2 - t1)
         with _lock:
-            _busy_s[key] = _busy_s.get(key, 0.0) + busy
+            for platform, ordinal, _dev in devices:
+                key = f"{platform}:{ordinal}"
+                _busy_s[key] = _busy_s.get(key, 0.0) + busy
             _dispatch_counts[self.name] = _dispatch_counts.get(self.name, 0) + 1
             hist = _dispatch_hist.get(self.name)
             if hist is None:
                 hist = _dispatch_hist[self.name] = Log2Histogram()
         hist.observe(busy)
-        mem = _sample_memory_of(dev)
+        # per-CHIP watermarks: each device track carries its OWN memory
+        # numbers (an HBM imbalance across a sharded dispatch is exactly
+        # what per-track spans exist to show); the module-level _mem
+        # keeps the last sample, same as the single-device bracket
+        mems = {
+            ordinal: _sample_memory_of(dev)
+            for _platform, ordinal, dev in devices
+        }
         if tracing.enabled():
-            span_args = dict(self.args)
-            span_args["enqueue_ms"] = round((t1 - self._t0) * 1000.0, 3)
-            span_args["device"] = key
-            if mem is not None:
-                span_args["mem_bytes_in_use"] = mem["bytes_in_use"]
-                span_args["mem_peak_bytes"] = mem["peak_bytes_in_use"]
-            tracing.record_span(
-                f"device.{self.name}",
-                t1,
-                t2,
-                parent=self._parent,
-                cat="device",
-                tid=DEVICE_TID_BASE + ordinal,
-                thread_name=f"device:{key}",
-                **span_args,
-            )
+            for platform, ordinal, _dev in devices:
+                key = f"{platform}:{ordinal}"
+                span_args = dict(self.args)
+                span_args["enqueue_ms"] = round((t1 - self._t0) * 1000.0, 3)
+                span_args["device"] = key
+                mem = mems.get(ordinal)
+                if mem is not None:
+                    span_args["mem_bytes_in_use"] = mem["bytes_in_use"]
+                    span_args["mem_peak_bytes"] = mem["peak_bytes_in_use"]
+                tracing.record_span(
+                    f"device.{self.name}",
+                    t1,
+                    t2,
+                    parent=self._parent,
+                    cat="device",
+                    tid=DEVICE_TID_BASE + ordinal,
+                    thread_name=f"device:{key}",
+                    **span_args,
+                )
         return out
 
 
-def dispatch(name: str, **args) -> Any:
-    """Open a dispatch bracket (no-op shared instance when inactive)."""
+def dispatch(name: str, multi_device: bool = False, **args) -> Any:
+    """Open a dispatch bracket (no-op shared instance when inactive).
+    ``multi_device=True`` records the bracket on every chip a sharded
+    output spans (one span per device track)."""
     if not active():
         return NULL_DISPATCH
-    return Dispatch(name, args)
+    return Dispatch(name, args, multi=multi_device)
 
 
 # ---------------------------------------------------------------------------
@@ -504,8 +548,20 @@ def device_profile() -> dict:
         "device_busy_ms": {k: round(v * 1000.0, 3) for k, v in busy.items()},
         "device_busy_ms_total": round(busy_ms_total, 3),
         "window_s": round(wall_s, 3),
+        # mean occupancy ACROSS chips: multi-device brackets charge the
+        # interval to every chip they span, so the wall denominator must
+        # scale with the chips that reported busy time — a single-wall
+        # denominator would inflate by the chip count and pin a mesh
+        # node at the 100% cap, killing the falling-occupancy regression
+        # signal exactly where it matters
         "device_occupancy_pct": round(
-            min(100.0, 100.0 * busy_ms_total / (wall_s * 1000.0)), 2
+            min(
+                100.0,
+                100.0
+                * busy_ms_total
+                / (wall_s * 1000.0 * max(1, len(busy))),
+            ),
+            2,
         ),
         "mem": mem if mem is not None else {"available": False},
         "notes": notes,

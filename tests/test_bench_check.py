@@ -110,6 +110,47 @@ def test_warm_speedup_higher_is_better(tmp_path, capsys):
     assert bench_check.main(["--dir", str(tmp_path)]) == 0
 
 
+def test_multichip_series_watched(tmp_path, capsys):
+    """extras.multichip: warm _ms figures regress lower-is-better,
+    blocks_per_s higher-is-better, cold compile walls are NOT watched,
+    and the platform prefix keeps cpu/device rounds apart."""
+    good = {"multichip": {
+        "platform": "cpu", "mesh": "2x4", "k": 32, "batch": 8,
+        "sharded_extend_32_ms": 200.0,
+        "sharded_extend_32_cold_ms": 60000.0,
+        "batched_8x32_blocks_per_s": 8.0,
+    }}
+    bad = {"multichip": {
+        "platform": "cpu", "mesh": "2x4", "k": 32, "batch": 8,
+        "sharded_extend_32_ms": 900.0,        # regressed (lower better)
+        "sharded_extend_32_cold_ms": 1.0,      # ignored either way
+        "batched_8x32_blocks_per_s": 2.0,      # regressed (higher better)
+    }}
+    _write_rounds(tmp_path, [_round(1, extras=good), _round(2, extras=bad)])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "multichip.cpu.2x4.sharded_extend_32_ms" in err
+    assert "multichip.cpu.2x4.batched_8x32_blocks_per_s" in err
+    assert "cold_ms" not in err
+    # a platform switch is a NEW series, never a regression
+    dev = {"multichip": {
+        "platform": "tpu", "mesh": "1x8", "k": 128, "batch": 8,
+        "sharded_extend_128_ms": 5.0,
+        "batched_8x128_blocks_per_s": 400.0,
+    }}
+    _write_rounds(tmp_path, [_round(1, extras=good), _round(2, extras=dev)])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+    # ... and so is a mesh-factoring switch at the same platform and k
+    # (fewer chips are legitimately slower, not a regression)
+    refit = {"multichip": {
+        "platform": "cpu", "mesh": "1x2", "k": 32, "batch": 8,
+        "sharded_extend_32_ms": 900.0,
+        "batched_8x32_blocks_per_s": 2.0,
+    }}
+    _write_rounds(tmp_path, [_round(1, extras=good), _round(2, extras=refit)])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+
+
 def test_unparsed_rounds_are_skipped_not_zeroed(tmp_path):
     _write_rounds(tmp_path, [
         _round(1, value=10.0),

@@ -240,3 +240,37 @@ def test_dispatch_bracketing_matches_byte_identity(tracer):
     tracing.disable()
     without = np.asarray(rs.extend_square(sq))
     assert np.array_equal(with_track, without)
+
+
+def test_multi_device_dispatch_records_every_chip():
+    """dispatch(multi_device=True) on a sharded output charges the
+    t1->t2 interval to EVERY chip the array spans (one busy entry per
+    device — the cross-chip occupancy accounting the sharded extension
+    path relies on); a non-sharded output degrades to the single-device
+    bracket."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from celestia_tpu.parallel.sharded import make_mesh
+
+    mesh = make_mesh(jax.devices()[:2], data=1, row=2)
+    x = jax.device_put(
+        jnp.zeros((4, 4), dtype=jnp.int32),
+        NamedSharding(mesh, P("row", None)),
+    )
+    with devprof.collect():
+        d = devprof.dispatch("multi_test", multi_device=True)
+        d.done(x)
+        prof = devprof.device_profile()
+    busy = prof["device_busy_ms"]
+    assert len(busy) == 2, busy
+    assert prof["dispatches"]["multi_test"] == 1  # counted once, not per chip
+
+    # single-device output under the same flag: one busy key
+    y = jnp.zeros((4,), dtype=jnp.int32)
+    with devprof.collect():
+        d = devprof.dispatch("single_test", multi_device=True)
+        d.done(y)
+        prof = devprof.device_profile()
+    assert len(prof["device_busy_ms"]) == 1

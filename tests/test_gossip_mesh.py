@@ -377,6 +377,64 @@ def test_mesh_survives_one_dead_validator_and_catches_it_up():
         _teardown(servers, engines)
 
 
+def test_bft_catchup_batch_adopts_window_and_stops_on_bad_wire():
+    """The batched catch-up entry (node.bft_catchup_batch, ISSUE 14):
+    a laggard adopts a whole window of decided blocks in one call —
+    the extends warm as a batch when a mesh is active (exercised in
+    tests/_mesh_live_isolated.py; here the mesh is off, proving the
+    plain degradation path adopts identically) — and a tampered wire
+    mid-window stops adoption exactly where per-block replay would."""
+    _warm()
+    keys, nodes, servers, engines = _mesh("mesh-batchcatch", n=3)
+    try:
+        for e in engines:
+            e.start()
+        _wait_height(nodes, 5)
+        for e in engines:
+            e.stop()
+        src = nodes[0]
+        wires = []
+        for h in range(2, src.height + 1):
+            d = src.bft_decided(h)
+            if d is None:
+                break
+            wires.append(d)
+        assert len(wires) >= 3
+        # a fresh laggard on the same chain (height 1 after genesis)
+        laggard = TestNode(
+            chain_id="mesh-batchcatch",
+            genesis=_genesis(keys, "mesh-batchcatch"),
+            validator_key=keys[0],
+            auto_produce=False,
+        )
+        laggard.enable_bft(_valset(keys))
+        adopted, why = laggard.bft_catchup_batch(wires)
+        assert adopted == len(wires), why
+        assert laggard.height == 1 + len(wires)
+        assert laggard.app.store.committed_hash(
+            laggard.height
+        ) == src.app.store.committed_hash(laggard.height)
+
+        # tampered certificate mid-window: adoption stops at the bad wire
+        laggard2 = TestNode(
+            chain_id="mesh-batchcatch",
+            genesis=_genesis(keys, "mesh-batchcatch"),
+            validator_key=keys[0],
+            auto_produce=False,
+        )
+        laggard2.enable_bft(_valset(keys))
+        import copy
+
+        bad = copy.deepcopy(wires)
+        bad[1]["precommits"] = bad[1]["precommits"][:1]  # below 2/3
+        adopted, why = laggard2.bft_catchup_batch(bad)
+        assert adopted == 1
+        assert why
+        assert laggard2.height == 2
+    finally:
+        _teardown(servers, engines)
+
+
 @pytest.mark.slow
 def test_mesh_three_os_processes(tmp_path_factory):
     """Full dress: three ``start --bft-valset --peers`` OS processes and

@@ -1,0 +1,132 @@
+"""Mesh provider (parallel/mesh.py) unit tests — tier-1 cheap.
+
+Pure policy logic: no sharded program is ever compiled here (that lives
+in tests/_mesh_live_isolated.py, subprocess-isolated like the sharded
+suite).  Building a jax.sharding.Mesh object over the virtual CPU
+devices is metadata only.
+"""
+
+import pytest
+
+from celestia_tpu.parallel import mesh as mesh_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_provider():
+    """The provider is pin-once per process by design; tests reset it
+    around themselves so the rest of the suite sees the default (auto →
+    OFF on the CPU backend).  Poison is LOUD by contract — it records a
+    process-global degradation — so the fault ledger is reset too
+    (same teardown the chaos fixture guarantees), or the deliberate
+    poisons here would read as a degraded node to every later test
+    (healthz, alert rules)."""
+    from celestia_tpu.utils import faults
+
+    mesh_mod._reset_for_tests()
+    yield
+    mesh_mod._reset_for_tests()
+    faults.reset_stats()
+
+
+def test_parse_spec_forms():
+    assert mesh_mod.parse_spec("2x4") == (2, 4)
+    assert mesh_mod.parse_spec(" 1X8 ") == (1, 8)
+    assert mesh_mod.parse_spec("off") == (0, 0)
+    assert mesh_mod.parse_spec("none") == (0, 0)
+    assert mesh_mod.parse_spec("") is None
+    assert mesh_mod.parse_spec("auto") is None
+    for bad in ("2x", "x4", "axb", "2x4x8", "-1x4", "0x4"):
+        with pytest.raises(ValueError):
+            mesh_mod.parse_spec(bad)
+
+
+def test_auto_stays_off_on_cpu_backend():
+    # the tier-1 env has 8 FORCED host devices (conftest) — virtual
+    # slices of one CPU; auto must not shard over them
+    assert mesh_mod.device_mesh() is None
+    assert mesh_mod.mesh_for_square(128) is None
+    s = mesh_mod.stats()
+    assert s["active"] is False and s["poisoned"] is None
+
+
+def test_explicit_spec_builds_virtual_mesh():
+    mesh_mod.configure("2x4")
+    m = mesh_mod.device_mesh()
+    assert m is not None
+    assert dict(m.shape) == {"data": 2, "row": 4}
+    assert mesh_mod.mesh_shape() == (2, 4)
+    # resolution is cached: same object back
+    assert mesh_mod.device_mesh() is m
+
+
+def test_mesh_for_square_divisibility_fallback():
+    mesh_mod.configure("1x4")
+    assert mesh_mod.mesh_for_square(8) is not None
+    assert mesh_mod.mesh_for_square(4) is not None
+    # k < row and k % row != 0 both fall back, counted
+    assert mesh_mod.mesh_for_square(2) is None
+    assert mesh_mod.mesh_for_square(1) is None  # the min-DAH square
+    assert mesh_mod.mesh_for_square(6) is None
+    assert mesh_mod.stats()["fallback_squares"] == 3
+
+
+def test_off_spec_disables():
+    mesh_mod.configure("off")
+    assert mesh_mod.device_mesh() is None
+
+
+def test_env_spec_honored(monkeypatch):
+    monkeypatch.setenv(mesh_mod.ENV_MESH, "1x2")
+    mesh_mod._reset_for_tests()
+    m = mesh_mod.device_mesh()
+    assert m is not None and dict(m.shape) == {"data": 1, "row": 2}
+    # the --mesh flag (configure) wins over the env
+    mesh_mod.configure("off")
+    assert mesh_mod.device_mesh() is None
+
+
+def test_oversized_spec_poisons_not_raises():
+    mesh_mod.configure("4x8")  # 32 devices; only 8 visible
+    assert mesh_mod.device_mesh() is None
+    assert "devices" in (mesh_mod.poisoned() or "")
+
+
+def test_malformed_env_poisons_not_raises(monkeypatch):
+    # a typo'd CELESTIA_TPU_MESH must degrade loudly, never crash the
+    # block hot path (configure() is the eager-raise surface, the env
+    # is resolved lazily mid-block)
+    monkeypatch.setenv(mesh_mod.ENV_MESH, "2by4")
+    mesh_mod._reset_for_tests()
+    assert mesh_mod.device_mesh() is None
+    assert "mesh spec" in (mesh_mod.poisoned() or "")
+
+
+def test_poison_is_one_way():
+    mesh_mod.configure("1x4")
+    assert mesh_mod.device_mesh() is not None
+    mesh_mod.poison("deliberate test pin")
+    assert mesh_mod.device_mesh() is None
+    assert mesh_mod.mesh_for_square(8) is None
+    # first reason wins
+    mesh_mod.poison("second fault")
+    assert mesh_mod.poisoned() == "deliberate test pin"
+    with pytest.raises(RuntimeError):
+        mesh_mod.clear_poison()
+    mesh_mod.clear_poison(force=True)
+    assert mesh_mod.device_mesh() is not None
+
+
+def test_configure_raises_eagerly():
+    with pytest.raises(ValueError):
+        mesh_mod.configure("garbage")
+
+
+def test_stats_counters_roundtrip():
+    mesh_mod.configure("1x2")
+    assert mesh_mod.device_mesh() is not None
+    mesh_mod.record_sharded_extend()
+    mesh_mod.record_sharded_extend(batched=True, squares=4)
+    s = mesh_mod.stats()
+    assert s["sharded_extends"] == 5
+    assert s["batched_dispatches"] == 1
+    assert s["data"] == 1 and s["row"] == 2

@@ -25,6 +25,14 @@ What counts as a headline metric (see BASELINE.md for meanings):
   skipped),
 * ``extras.device_profile.device_occupancy_pct`` (HIGHER is better —
   falling occupancy at equal work means growing dispatch gaps),
+* ``extras.multichip`` (the sharded mesh series): every warm ``*_ms``
+  figure (lower is better; ``*_cold_ms`` compile walls are recorded but
+  not watched — single-run XLA compile is host-load noise) and every
+  ``*_blocks_per_s`` throughput (HIGHER is better).  Metric names are
+  prefixed with the recording platform + mesh factoring AND carry the
+  k/batch config, so a reduced virtual-CPU-mesh round, a full-size
+  device round, and rounds on differently-provisioned chip counts can
+  never cross-compare,
 * ``extras.host_profile.sampler_overhead_pct`` — judged against an
   ABSOLUTE 2% ceiling on the latest round (the continuous-profiling
   cost contract: the sampler must stay under 2% of the leg wall it
@@ -111,6 +119,19 @@ def _flat_headlines(parsed: dict):
                         and not isinstance(pv, bool)
                     ):
                         yield f"trace_summary.{block}.{pk}", float(pv), False
+        elif key == "multichip" and isinstance(val, dict):
+            # platform AND mesh factoring in the name: the same k on a
+            # different chip count is a different series (a 1x4 round
+            # must not alarm against a 1x8 best-so-far)
+            platform = val.get("platform", "unknown")
+            series = f"multichip.{platform}.{val.get('mesh', 'nomesh')}"
+            for mk, mv in sorted(val.items()):
+                if isinstance(mv, bool) or not isinstance(mv, (int, float)):
+                    continue
+                if mk.endswith("_blocks_per_s"):
+                    yield f"{series}.{mk}", float(mv), True
+                elif mk.endswith("_ms") and not mk.endswith("_cold_ms"):
+                    yield f"{series}.{mk}", float(mv), False
         elif key == "device_profile" and isinstance(val, dict):
             occ = val.get("device_occupancy_pct")
             if isinstance(occ, (int, float)) and not isinstance(occ, bool):

@@ -7,7 +7,7 @@ PY ?= python
 # passes --format through; exit codes are unchanged either way
 LINT_FORMAT ?=
 
-.PHONY: lint lockwatch test chaos trace-smoke profile-smoke incident-smoke multichip-smoke mesh-live t1-budget bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
+.PHONY: lint lockwatch test chaos trace-smoke profile-smoke incident-smoke multichip-smoke das-smoke mesh-live t1-budget bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
 
 ## celint: concurrency & determinism static analysis (exit 1 on findings)
 lint:
@@ -69,6 +69,17 @@ incident-smoke:
 ## tracks and that the EDS cache served the process leg warm
 multichip-smoke:
 	$(PY) tools/multichip_smoke.py
+
+## DA serving-plane boot gate: a tiny-k node serves a chunked multi-cell
+## DasSampleBatch over the real gRPC boundary — every proof verifies
+## against the data root (one pinned byte-identical to the per-cell
+## prover), the das_rows cache answers the second pass warm, a saturated
+## gate sheds the batch with retry_after_ms and the RetryPolicy client
+## resumes, and the exposition stays parse-valid with the
+## celestia_tpu_das_* counters present (tier-1 runs the same assertions
+## via tests/test_das_smoke.py)
+das-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/das_smoke.py
 
 ## full live mesh-path suite (slow tier: each subprocess child pays one
 ## ~35-60 s structure-bound XLA CPU shard_map compile, over the 30 s

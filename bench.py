@@ -1097,6 +1097,68 @@ def _lint_stats_extras() -> dict:
     }
 
 
+def _das_serving_extras(k: int, n_samples: int = 256) -> dict:
+    """extras.das_serving (BASELINE.md): the vectorized DA serving plane
+    at k x k — samples/sec for the per-cell prover loop (the pre-batch
+    serving cost, uncached by construction) vs the batched prover cold
+    (row stacks built once per row) and warm (das_rows cache serving
+    pure proof-path extraction).  Keys are k-stamped so rounds at
+    different square sizes never cross-compare in bench_check.  The leg
+    ASSERTS batch-vs-scalar proof byte-identity — a faster prover that
+    changes one proof byte is a failed leg, not a better number."""
+    from celestia_tpu.da import dah as dah_mod
+    from celestia_tpu.da import das as das_mod
+
+    rng = np.random.default_rng(12)
+    square = rng.integers(0, 256, (k, k, 512), dtype=np.uint8)
+    square[:, :, :29] = 0
+    square[:, :, 28] = rng.integers(1, 200, (k, k), dtype=np.uint8)
+    eds, dah = dah_mod.extend_and_header(square)
+    n2 = 2 * k
+    n = min(int(n_samples), n2 * n2)
+    flat = np.random.default_rng(13).choice(n2 * n2, size=n, replace=False)
+    coords = [(int(f) // n2, int(f) % n2) for f in flat]
+
+    # per-cell loop: every sample rebuilds its row stack + the 4k-root
+    # tree (the serving cost before this plane existed)
+    t0 = time.perf_counter()
+    scalar = [das_mod._sample_proof_uncached(eds, dah, r, c) for r, c in coords]
+    scalar_s = time.perf_counter() - t0
+
+    das_mod.rows_cache().clear()
+    t0 = time.perf_counter()
+    cold = das_mod.sample_proofs_batch(eds, dah, coords)
+    cold_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    warm = das_mod.sample_proofs_batch(eds, dah, coords)
+    warm_s = time.perf_counter() - t0
+
+    # explicit raise, not assert: python -O must not be able to record
+    # a faster-but-wrong prover's figures as byte_identical
+    if cold != scalar or warm != scalar:
+        raise RuntimeError(
+            "batch prover output diverged from the per-cell prover"
+        )
+    stats = das_mod.rows_cache().stats()
+    out = {
+        "k": k,
+        "samples": n,
+        "rows_touched": len({r for r, _ in coords}),
+        f"scalar_k{k}_samples_per_s": round(n / scalar_s, 1),
+        f"batch_cold_k{k}_samples_per_s": round(n / cold_s, 1),
+        f"batch_warm_k{k}_samples_per_s": round(n / warm_s, 1),
+        f"warm_batch_vs_scalar_k{k}_speedup": round(scalar_s / warm_s, 2),
+        "byte_identical": True,
+        "das_rows": {
+            "hits": stats["hits"],
+            "misses": stats["misses"],
+            "hit_rate": stats["hit_rate"],
+            "approx_bytes": stats["approx_bytes"],
+        },
+    }
+    return out
+
+
 def _host_repair_ms(k: int):
     """Host-only repair (the light-client/DAS path — no accelerator):
     25% withheld, root-verified.  Under the leopard codec this runs the
@@ -1263,6 +1325,12 @@ def _host_only_main():
         extras["multichip"] = _multichip_extras()
     except Exception as e:
         extras["multichip_error"] = repr(e)[:200]
+    try:
+        # vectorized DA serving plane: batched multi-sample prover vs
+        # the per-cell loop, cold vs warm (byte-identity asserted)
+        extras["das_serving"] = _das_serving_extras(K)
+    except Exception as e:
+        extras["das_serving_error"] = repr(e)[:200]
     try:
         # LAST: snapshot after every leg has exercised its caches
         extras["unified_caches"] = _unified_cache_stats()
@@ -1443,6 +1511,12 @@ def main():
         extras["multichip"] = _multichip_extras()
     except Exception as e:
         extras["multichip_error"] = repr(e)[:200]
+    try:
+        # vectorized DA serving plane: batched multi-sample prover vs
+        # the per-cell loop, cold vs warm (byte-identity asserted)
+        extras["das_serving"] = _das_serving_extras(k)
+    except Exception as e:
+        extras["das_serving_error"] = repr(e)[:200]
     try:
         # LAST: snapshot after every leg has exercised its caches
         extras["unified_caches"] = _unified_cache_stats()

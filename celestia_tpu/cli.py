@@ -914,7 +914,11 @@ def cmd_query(args) -> int:
                 "tuple_root": v.tuple_root.hex(),
             }))
     elif args.query_cmd == "das-sample":
-        # fetch + VERIFY n random samples like a light client would
+        # fetch + VERIFY n random samples like a light client would;
+        # the whole draw rides the vectorized serving plane by default
+        # (ONE DasSampleBatch stream against a remote node, one
+        # row-grouped batch query in-process) — --per-cell keeps the
+        # scalar path for comparison/debugging
         from celestia_tpu.da import das as das_mod
 
         blk = node.block(int(args.height))
@@ -930,7 +934,27 @@ def cmd_query(args) -> int:
             )
             return das_mod.SampleProof.from_dict(out["proof"])
 
-        result = lc.sample(fetch, int(args.samples))
+        def fetch_batch(coords):
+            if hasattr(node, "das_sample_batch"):
+                out = node.das_sample_batch(int(args.height), coords)
+            else:
+                out = node.abci_query(
+                    "custom/das/sample_batch",
+                    {
+                        "height": args.height,
+                        "coords": [[r, c] for r, c in coords],
+                    },
+                )
+            return [
+                das_mod.SampleProof.from_dict(d) for d in out["proofs"]
+            ]
+
+        if getattr(args, "per_cell", False):
+            result = lc.sample(fetch, int(args.samples))
+        else:
+            result = lc.sample(
+                fetch_batch=fetch_batch, n_samples=int(args.samples)
+            )
         print(json.dumps({
             "available": result.available,
             "verified": result.verified,
@@ -1813,6 +1837,11 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("height", type=int)
     q.add_argument("--samples", type=int, default=16)
     q.add_argument("--seed", type=int, default=0)
+    q.add_argument(
+        "--per-cell", action="store_true",
+        help="fetch each sample with a separate DasSample RPC instead "
+             "of the batched serving plane (comparison/debugging)",
+    )
     q = qs.add_parser(
         "namespace-shares", help="all shares of a namespace, verified"
     )

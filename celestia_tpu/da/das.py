@@ -23,12 +23,31 @@ Host hashing: one sample touches a single 2k-leaf tree; the per-level
 device dispatches would cost more in launch latency than the ~2k SHA-256
 calls cost on the host, so the prover hashes rows host-side (native C++
 when available).
+
+Serving plane (the vectorized path a production node fields millions of
+light clients through):
+
+  sample_proofs_batch — one request -> n cells.  Coordinates are grouped
+      by row, each touched row's NMT level stack is built ONCE through
+      the threaded host batch kernels (ops/sha256.sha256_batch_host —
+      native SHA-NI via the hostpool, sharded hashlib otherwise), and
+      one RFC-6962 level tree over the DAH's 4k axis roots serves every
+      cell's root proof.  Emitted proofs are byte-identical to the
+      per-cell prover (pinned by tests/test_das.py and the bench leg).
+  das_rows cache — bounded LruCache (celint R2) of immutable row level
+      stacks keyed ``(data_root, row)`` (plus the block's root tree at
+      ``(data_root, "roots")``), layered on top of the EDS cache: a warm
+      block answers ANY cell of a cached row with pure proof-path
+      extraction.  Keys bind to the data root, so a stack cached for one
+      block can never serve another; hit/miss telemetry rides the
+      unified cache registry like every other cache.
 """
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -38,10 +57,13 @@ from celestia_tpu.da.namespace import PARITY_SHARE_NAMESPACE
 from celestia_tpu.da.proof import (
     MerkleProof,
     NmtRangeProof,
+    merkle_level_tree,
     merkle_proof,
+    merkle_proof_from_levels,
     nmt_range_proof_from_levels,
 )
 from celestia_tpu.ops import nmt as nmt_ops
+from celestia_tpu.utils.lru import LruCache
 
 
 def _row_leaves(eds: ExtendedDataSquare, row: int) -> np.ndarray:
@@ -62,7 +84,9 @@ def _row_leaves(eds: ExtendedDataSquare, row: int) -> np.ndarray:
 
 
 def _host_level_stack(leaves: np.ndarray) -> List[np.ndarray]:
-    """NMT level stack of one small tree on the host."""
+    """NMT level stack of one small tree on the host (serial reference;
+    the serving path uses :func:`_row_level_stacks_host`, pinned
+    byte-identical to this by tests/test_das.py)."""
     digests = [
         nmt_ops.leaf_digest_np(leaves[i].tobytes()) for i in range(len(leaves))
     ]
@@ -76,6 +100,97 @@ def _host_level_stack(leaves: np.ndarray) -> List[np.ndarray]:
             np.frombuffer(b"".join(digests), dtype=np.uint8).reshape(-1, 90)
         )
     return levels
+
+
+_PARITY_NS = np.frombuffer(PARITY_SHARE_NAMESPACE.raw, dtype=np.uint8)
+
+
+def _row_level_stacks_host(leaves: np.ndarray) -> List[List[np.ndarray]]:
+    """Level stacks of R same-size NMTs: uint8[R, n, L] namespace-prefixed
+    leaves -> R stacks of ``[(n, 90), (n/2, 90), ..., (1, 90)]``.
+
+    The batched counterpart of :func:`_host_level_stack`: ONE
+    ``sha256_batch_host`` dispatch per tree level across ALL rows
+    (native SHA-NI on the hostpool when available) instead of
+    rows x leaves scalar hashlib calls.  Byte-identical by construction
+    — same leaf rule (ns || ns || sha256(0x00 || leaf)) and the same
+    IgnoreMaxNamespace combine as ops/nmt.combine_digests_np.  Returned
+    arrays are frozen (read-only): they are shared through the das_rows
+    cache."""
+    from celestia_tpu.ops.sha256 import sha256_batch_host
+
+    R, n, L = leaves.shape
+    ns = leaves[:, :, :NAMESPACE_SIZE]
+    prefix = np.zeros((R, n, 1), dtype=np.uint8)
+    h = sha256_batch_host(
+        np.concatenate([prefix, leaves], axis=-1).reshape(R * n, L + 1)
+    ).reshape(R, n, 32)
+    levels = [np.concatenate([ns, ns, h], axis=-1)]
+    while levels[-1].shape[1] > 1:
+        cur = levels[-1]
+        left, right = cur[:, 0::2], cur[:, 1::2]
+        l_max = left[..., NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
+        r_min = right[..., :NAMESPACE_SIZE]
+        r_max = right[..., NAMESPACE_SIZE : 2 * NAMESPACE_SIZE]
+        r_is_parity = np.all(r_min == _PARITY_NS, axis=-1, keepdims=True)
+        max_ns = np.where(r_is_parity, l_max, r_max)
+        one = np.ones(left.shape[:-1] + (1,), dtype=np.uint8)
+        h = sha256_batch_host(
+            np.concatenate([one, left, right], axis=-1).reshape(
+                -1, 1 + 2 * nmt_ops.NMT_DIGEST_SIZE
+            )
+        ).reshape(left.shape[:-1] + (32,))
+        levels.append(
+            np.concatenate([left[..., :NAMESPACE_SIZE], max_ns, h], axis=-1)
+        )
+    stacks: List[List[np.ndarray]] = []
+    for r in range(R):
+        stack = []
+        for lv in levels:
+            a = np.ascontiguousarray(lv[r])
+            a.flags.writeable = False
+            stack.append(a)
+        stacks.append(stack)
+    return stacks
+
+
+# ---------------------------------------------------------------------------
+# das_rows: the bounded proof/row cache (serving plane, ROADMAP #4)
+# ---------------------------------------------------------------------------
+
+# Keys: (data_root, row) -> that row's frozen NMT level stack;
+#        (data_root, "roots") -> the block's RFC-6962 level tree over the
+#        4k axis roots.  Binding every key to the data root means a warm
+#        entry can NEVER serve a different block — a wrong root is a
+#        plain miss, recomputed honestly (adversarial tests pin this).
+# A k=128 row stack is ~46 KiB (2 x 256 x 90 B of digests), so the
+# default byte budget (~32 MiB) holds several hundred hot rows across a
+# handful of recent blocks on top of the EDS cache's squares.
+_ROWS_MAX_ENTRIES = int(os.environ.get("CELESTIA_TPU_DAS_ROWS", "8192"))
+_ROWS_MAX_BYTES = int(
+    float(os.environ.get("CELESTIA_TPU_DAS_ROWS_MB", "32")) * 1024 * 1024
+)
+
+
+def _levels_weigher(key, value) -> int:
+    try:
+        return sum(int(lv.nbytes) for lv in value) + 64
+    except Exception:
+        return 64
+
+
+_ROWS_CACHE = LruCache(
+    "das_rows",
+    _ROWS_MAX_ENTRIES,
+    weigher=_levels_weigher,
+    max_bytes=_ROWS_MAX_BYTES,
+)
+
+
+def rows_cache() -> LruCache:
+    """The process-global das_rows cache (content keyed: sharing across
+    App instances is safe for the same reason the EDS cache is)."""
+    return _ROWS_CACHE
 
 
 @dataclass(frozen=True)
@@ -154,13 +269,17 @@ class SampleProof:
         )
 
 
-def sample_proof(
+def _sample_proof_uncached(
     eds: ExtendedDataSquare,
     dah: DataAvailabilityHeader,
     row: int,
     col: int,
 ) -> SampleProof:
-    """Prove one EDS cell (any quadrant) to the data root."""
+    """The original per-cell prover: rebuilds the row's full level stack
+    and the 4k-root list on EVERY call, touching no cache.  Kept as the
+    byte-identity reference for the batch path (tests + the bench leg's
+    per-sample baseline); production callers use :func:`sample_proof` /
+    :func:`sample_proofs_batch`."""
     k = eds.square_size
     if not (0 <= row < 2 * k and 0 <= col < 2 * k):
         raise ValueError(f"sample ({row}, {col}) outside the {2*k}x{2*k} EDS")
@@ -176,6 +295,93 @@ def sample_proof(
         row_root=dah.row_roots[row],
         root_proof=merkle_proof(all_roots, row),
     )
+
+
+def sample_proof(
+    eds: ExtendedDataSquare,
+    dah: DataAvailabilityHeader,
+    row: int,
+    col: int,
+) -> SampleProof:
+    """Prove one EDS cell (any quadrant) to the data root.
+
+    Internally a 1-cell :func:`sample_proofs_batch`: the single-cell RPC
+    path shares the das_rows cache, so a warm row answers with pure
+    proof-path extraction and the 4k-root merkle tree is built once per
+    block instead of once per call."""
+    return sample_proofs_batch(eds, dah, [(row, col)])[0]
+
+
+def sample_proofs_batch(
+    eds: ExtendedDataSquare,
+    dah: DataAvailabilityHeader,
+    coords: Sequence[Tuple[int, int]],
+) -> List[SampleProof]:
+    """Prove n EDS cells in one pass (proofs returned in ``coords``
+    order, each byte-identical to the per-cell prover's output).
+
+    Coordinates are grouped by row; every touched row's level stack is
+    built ONCE through the batched host kernels and cached under
+    ``(data_root, row)``, and one cached RFC-6962 level tree over the
+    DAH's 4k axis roots serves every root proof — n samples of a warm
+    block cost n proof-path extractions, not n full row passes."""
+    k = eds.square_size
+    n2 = 2 * k
+    coords = [(int(r), int(c)) for r, c in coords]
+    for row, col in coords:
+        if not (0 <= row < n2 and 0 <= col < n2):
+            raise ValueError(
+                f"sample ({row}, {col}) outside the {n2}x{n2} EDS"
+            )
+    if not coords:
+        return []
+    data_root = dah.hash
+    all_roots = list(dah.row_roots) + list(dah.col_roots)
+    total = len(all_roots)
+    # root-proof material: one balanced level tree per block (4k is a
+    # power of two whenever k is; anything else falls back to the
+    # per-call prover's tree walk)
+    root_levels = None
+    if total and not (total & (total - 1)):
+        root_levels = _ROWS_CACHE.get((data_root, "roots"))
+        if root_levels is None:
+            root_levels = merkle_level_tree(all_roots)
+            _ROWS_CACHE.put((data_root, "roots"), root_levels)
+    rows_needed = sorted({r for r, _ in coords})
+    cached = _ROWS_CACHE.get_many([(data_root, r) for r in rows_needed])
+    stacks = {
+        r: s for r, s in zip(rows_needed, cached) if s is not None
+    }
+    missing = [r for r in rows_needed if r not in stacks]
+    if missing:
+        built = _row_level_stacks_host(
+            np.stack([_row_leaves(eds, r) for r in missing])
+        )
+        _ROWS_CACHE.put_many(
+            ((data_root, r), s) for r, s in zip(missing, built)
+        )
+        stacks.update(zip(missing, built))
+    shares = eds.shares
+    out: List[SampleProof] = []
+    for row, col in coords:
+        nmt_proof = nmt_range_proof_from_levels(stacks[row], col, col + 1)
+        root_proof = (
+            merkle_proof_from_levels(root_levels, row)
+            if root_levels is not None
+            else merkle_proof(all_roots, row)
+        )
+        out.append(
+            SampleProof(
+                row=row,
+                col=col,
+                square_size=k,
+                share=np.asarray(shares[row, col]).tobytes(),
+                nmt_proof=nmt_proof,
+                row_root=dah.row_roots[row],
+                root_proof=root_proof,
+            )
+        )
+    return out
 
 
 @dataclass
@@ -217,17 +423,33 @@ class LightClient:
 
     def sample(
         self,
-        fetch: Callable[[int, int], Optional[SampleProof]],
+        fetch: Optional[Callable[[int, int], Optional[SampleProof]]] = None,
         n_samples: int = 16,
+        *,
+        fetch_batch: Optional[
+            Callable[[List[Tuple[int, int]]], Iterable[Optional[SampleProof]]]
+        ] = None,
     ) -> SampleResult:
         """Fetch + verify n uniformly-random cells.  A None response, a
         proof for the wrong coordinate, or a proof that fails verification
-        all count as withheld — a provider must PROVE every sampled cell."""
+        all count as withheld — a provider must PROVE every sampled cell.
+
+        ``fetch_batch`` routes the whole draw through the vectorized
+        serving plane (ONE request for all n cells — the DasSampleBatch
+        RPC); it receives the coordinate list and returns proofs (or
+        None) positionally.  A short response leaves the tail cells
+        "not served" — a provider cannot shrink the sample."""
+        if (fetch is None) == (fetch_batch is None):
+            raise ValueError("exactly one of fetch/fetch_batch is required")
         coords = self.pick_coordinates(n_samples)
+        if fetch_batch is not None:
+            proofs = list(fetch_batch(list(coords)))
+            proofs += [None] * (len(coords) - len(proofs))
+        else:
+            proofs = [fetch(row, col) for row, col in coords]
         verified = 0
         failed: List[Tuple[int, int, str]] = []
-        for row, col in coords:
-            proof = fetch(row, col)
+        for (row, col), proof in zip(coords, proofs):
             if proof is None:
                 failed.append((row, col, "not served"))
                 continue

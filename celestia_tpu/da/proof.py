@@ -190,6 +190,55 @@ class MerkleProof:
         return got == root and not aunts
 
 
+def merkle_level_tree(leaves: Sequence[bytes]) -> List[np.ndarray]:
+    """All levels of the RFC-6962 tree over a POWER-OF-TWO number of
+    equal-length leaves: ``[leaf hashes (n, 32), (n/2, 32), ..., root
+    (1, 32)]``, hashed through the threaded host batch kernel.
+
+    For power-of-two counts the tendermint split rule (largest power of
+    two strictly below n) degenerates to n/2 at every level, so the tree
+    is perfectly balanced and the proof for ANY index is a pure
+    level-stack extraction (:func:`merkle_proof_from_levels`) — the DAS
+    serving plane builds this ONCE per block over the DAH's 4k axis
+    roots instead of re-hashing the whole tree per sampled cell.
+    Byte-identical to :func:`merkle_proof` (pinned by tests/test_das.py).
+    """
+    from celestia_tpu.ops.sha256 import sha256_batch_host
+
+    n = len(leaves)
+    if n == 0 or n & (n - 1):
+        raise ValueError(f"leaf count must be a power of two, got {n}")
+    arr = np.frombuffer(b"".join(leaves), dtype=np.uint8).reshape(n, -1)
+    zero = np.zeros((n, 1), dtype=np.uint8)
+    levels = [sha256_batch_host(np.concatenate([zero, arr], axis=-1))]
+    while levels[-1].shape[0] > 1:
+        cur = levels[-1]
+        left, right = cur[0::2], cur[1::2]
+        one = np.ones((left.shape[0], 1), dtype=np.uint8)
+        levels.append(
+            sha256_batch_host(np.concatenate([one, left, right], axis=-1))
+        )
+    for lv in levels:
+        lv.flags.writeable = False  # served from a shared cache
+    return levels
+
+
+def merkle_proof_from_levels(
+    levels: List[np.ndarray], index: int
+) -> MerkleProof:
+    """Extract the proof for ``index`` from a :func:`merkle_level_tree`
+    stack: the level-``j`` aunt is the sibling subtree hash
+    ``levels[j][(index >> j) ^ 1]`` (aunts stored bottom-up, exactly the
+    order :func:`merkle_proof` records them in)."""
+    total = levels[0].shape[0]
+    if not 0 <= index < total:
+        raise ValueError(f"index {index} out of range for {total} leaves")
+    aunts = tuple(
+        levels[j][(index >> j) ^ 1].tobytes() for j in range(len(levels) - 1)
+    )
+    return MerkleProof(index, total, aunts)
+
+
 def merkle_proof(leaves: Sequence[bytes], index: int) -> MerkleProof:
     """Proof for leaf ``index`` over arbitrary-count leaves (tendermint
     simple merkle, split = largest power of two < n)."""

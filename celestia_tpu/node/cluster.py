@@ -295,6 +295,26 @@ def _peer_health(client) -> dict:
         for name, hits in sorted(cache_hits.items())
     }
     gossip = status.get("gossip", {})
+    # DAS serving plane rollup (batch prover + das_rows cache): read
+    # straight off the scrape, so the page needs no extra RPC.  Single-
+    # cell + batch sheds combined — a mesh shedding only on the batch
+    # plane must not read as a healthy serving plane — and computed
+    # ONCE: the legacy top-level das_shed references the same figure.
+    das = {
+        "samples_served": int(
+            by_name.get("celestia_tpu_das_samples_served_total", 0)
+        ),
+        "batch_calls": int(
+            by_name.get("celestia_tpu_das_batch_calls_total", 0)
+        ),
+        "shed": int(
+            by_name.get("celestia_tpu_das_sample_shed_total", 0)
+        )
+        + int(by_name.get("celestia_tpu_das_batch_shed_total", 0)),
+        "rows_hit_rate": float(
+            by_name.get("celestia_tpu_das_rows_hit_rate", 0.0)
+        ),
+    }
     return {
         "node_id": node_info
         or str(getattr(client, "address", "") or status.get("chain_id", "")),
@@ -312,9 +332,8 @@ def _peer_health(client) -> dict:
         "degradations": int(
             by_name.get("celestia_tpu_degradations_total", 0)
         ),
-        "das_shed": int(
-            by_name.get("celestia_tpu_das_sample_shed_total", 0)
-        ),
+        "das_shed": das["shed"],
+        "das": das,
         "caches": caches,
         "rpc": rpc,
         # trace-ring health (PR 11 satellite): silent span truncation
@@ -385,6 +404,16 @@ def cluster_health(clients, probes: int = 3) -> dict:
         ),
         "degradations": sum(p["degradations"] for p in healthy),
         "das_shed": sum(p["das_shed"] for p in healthy),
+        # serving-plane rollup: total cells served across the mesh and
+        # the peers shedding batch load (the ones to scale out first)
+        "das_samples_served": sum(
+            p.get("das", {}).get("samples_served", 0) for p in healthy
+        ),
+        "das_shedding_peers": sorted(
+            p["node_id"]
+            for p in healthy
+            if p.get("das", {}).get("shed", 0) > 0
+        ),
         "fault_notes": sum(p["fault_notes"] for p in healthy),
         # mesh-wide degradation flags (PR 11): summed trace truncation
         # and every peer with at least one firing alert rule — the

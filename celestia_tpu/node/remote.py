@@ -103,6 +103,33 @@ class RemoteNode:
     def _call_json(self, method: str, obj: dict) -> dict:
         return json.loads(self._call(method, json.dumps(obj).encode()))
 
+    def _call_stream(self, method: str, payload: bytes):
+        """Server-streaming call: yields response messages as bytes.
+        Same byte/count telemetry as :meth:`_call`, accumulated per
+        received message."""
+        fn = self._methods.get(("stream", method))
+        if fn is None:
+            fn = self._channel.unary_stream(
+                f"/{SERVICE}/{method}",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            self._methods[("stream", method)] = fn
+        prefix = f"rpc_client_{snake_case(method)}"
+        RPC_TELEMETRY.incr(f"{prefix}_calls")
+        RPC_TELEMETRY.incr(f"{prefix}_bytes_out", len(payload))
+        try:
+            for resp in fn(payload, timeout=self.timeout_s):
+                RPC_TELEMETRY.incr(
+                    f"{prefix}_bytes_in", len(resp) if resp else 0
+                )
+                yield resp
+        except grpc.RpcError as e:
+            RPC_TELEMETRY.incr(f"{prefix}_errors")
+            raise RemoteError(
+                f"{method}: {e.code().name} {e.details()}"
+            ) from e
+
     @staticmethod
     def _attach_tc(payload: dict, tc=None, height: int = 0) -> dict:
         """Attach the optional cross-node trace context: an explicit
@@ -402,6 +429,98 @@ class RemoteNode:
             if out.get("code"):
                 raise RemoteError(out.get("log", "das sample failed"))
             return out
+
+        return policy.run(attempt, retry_on=(faults.Overloaded,))
+
+    def das_sample_batch(
+        self, height: int, coords, *, policy=None, chunk: int = 0
+    ) -> dict:
+        """n DAS cells + proofs in ONE streaming request (the
+        DasSampleBatch RPC): the server proves row-grouped chunks and
+        streams them back, re-passing its load-shed gate per chunk.
+
+        A mid-stream shed carries ``served`` (cells already streamed)
+        and ``retry_after_ms``; this client keeps every proof it has,
+        drops the served prefix, and retries ONLY the remainder through
+        the unified RetryPolicy — honest pushback costs re-requesting
+        nothing.  Returns ``{"proofs": [...], "data_root": hex}`` with
+        proofs in the requested coordinate order; the final shed attempt
+        raises :class:`faults.Overloaded`."""
+        from celestia_tpu.utils import faults
+
+        if policy is None:
+            policy = faults.RetryPolicy(
+                attempts=6, base_s=0.02, cap_s=0.25,
+                deadline_s=self.timeout_s,
+            )
+        remaining = [(int(r), int(c)) for r, c in coords]
+        proofs: list = []
+        state = {"data_root": ""}
+
+        def attempt():
+            payload = {
+                "height": int(height),
+                "coords": [[r, c] for r, c in remaining],
+            }
+            if chunk:
+                payload["chunk"] = int(chunk)
+            stream = self._call_stream(
+                "DasSampleBatch",
+                json.dumps(
+                    self._attach_tc(payload, height=int(height))
+                ).encode(),
+            )
+            while True:
+                try:
+                    resp = next(stream)
+                except StopIteration:
+                    break
+                except RemoteError as e:
+                    # a transport drop MID-conversation (some chunks
+                    # already landed, this attempt or an earlier one) is
+                    # retried like shed load — partial progress is kept
+                    # and only the remainder re-requested, exactly as a
+                    # clean early EOF would be.  A server that never
+                    # answered at all stays a hard RemoteError.
+                    if proofs:
+                        raise faults.Overloaded(
+                            f"DAS batch stream dropped: {e}",
+                            retry_after_ms=25.0,
+                        ) from e
+                    raise
+                out = json.loads(resp)
+                if out.get("shed"):
+                    # every chunk already streamed trimmed `remaining`
+                    # below, so the retry asks only for the rest
+                    raise faults.Overloaded(
+                        out.get("log")
+                        or "DAS serving plane shed the batch",
+                        retry_after_ms=float(
+                            out.get("retry_after_ms", 25.0)
+                        ),
+                    )
+                if out.get("code"):
+                    raise RemoteError(
+                        out.get("log", "das sample batch failed")
+                    )
+                got = out.get("proofs", [])
+                proofs.extend(got)
+                del remaining[: len(got)]
+                root = out.get("data_root", "")
+                if state["data_root"] and root != state["data_root"]:
+                    raise RemoteError(
+                        "data_root changed mid-stream"
+                    )
+                state["data_root"] = root
+            if remaining:
+                # stream ended without a shed marker but short: treat
+                # as overload (a crashing server must not look like a
+                # complete answer)
+                raise faults.Overloaded(
+                    "DAS batch stream ended early",
+                    retry_after_ms=25.0,
+                )
+            return {"proofs": proofs, "data_root": state["data_root"]}
 
         return policy.run(attempt, retry_on=(faults.Overloaded,))
 

@@ -309,6 +309,7 @@ class NodeService:
             ):
                 faults.fire("server.sample")
                 out = self.node.abci_query("custom/das/sample", q)
+            self.node.app.telemetry.incr("das_samples_served")
             return json.dumps({"shed": False, **out}, default=str).encode()
         except faults.InjectedFault as e:
             return json.dumps(
@@ -322,6 +323,117 @@ class NodeService:
             return json.dumps({"code": 1, "log": str(e)}).encode()
         finally:
             self.das_gate.release()
+
+    # DasSampleBatch chunking: cells proven (and streamed) per response
+    # message.  Bounds BOTH the per-message JSON size (a 10k-cell
+    # request never builds one giant blob — a 64-proof chunk is ~100 KiB
+    # on the wire, far under the 4 MiB transport cap) and the admission
+    # granularity: every chunk re-passes the shed gate, weighted by the
+    # distinct rows it proves.
+    DAS_BATCH_CHUNK = 64
+
+    def das_sample_batch(self, req: bytes, ctx):
+        """Streaming DAS batch prover: one request -> n cells, served as
+        chunked responses behind the load-shed gate.
+
+        Each chunk is admitted SEPARATELY with weight = the distinct
+        rows it proves (the row level stack is the unit of prover work),
+        and chunk boundaries keep that weight STRICTLY below the gate's
+        ``max_inflight`` — so every chunk is individually admissible
+        under concurrent traffic, while an n-cell batch still consumes
+        admission proportional to its size.  Batching therefore cannot
+        launder load past the gate, and a saturated node
+        sheds mid-stream with ``retry_after_ms`` + the count of cells
+        already ``served`` so an honest client resumes the remainder
+        through the unified RetryPolicy instead of re-requesting served
+        cells.  The ``server.sample`` fault point makes every chunk
+        injectable for the chaos suite, reported as retriable exactly
+        like shed load."""
+        q = json.loads(req or b"{}")
+        coords = [(int(r), int(c)) for r, c in q.get("coords", [])]
+        height = int(q.get("height", 0) or 0)
+        chunk = max(
+            1, min(int(q.get("chunk", 0) or self.DAS_BATCH_CHUNK),
+                   self.DAS_BATCH_CHUNK)
+        )
+        # chunk boundaries respect BOTH caps: <= `chunk` cells (message
+        # size) AND < max_inflight distinct rows (admission weight).
+        # STRICTLY below the gate bound: try_acquire(w) needs
+        # inflight + w <= max_inflight once anything is in flight, so a
+        # chunk weighing the full bound — like one weighing more — could
+        # only ever be admitted idle and would shed under ANY concurrent
+        # traffic, starving honest batch clients at modest load
+        max_rows = max(1, self.das_gate.max_inflight - 1)
+        chunks: list = []
+        cur: list = []
+        cur_rows: set = set()
+        for rc in coords:
+            if cur and (
+                len(cur) >= chunk
+                or (rc[0] not in cur_rows and len(cur_rows) >= max_rows)
+            ):
+                chunks.append(cur)
+                cur, cur_rows = [], set()
+            cur.append(rc)
+            cur_rows.add(rc[0])
+        if cur:
+            chunks.append(cur)
+        telemetry = self.node.app.telemetry
+        telemetry.incr("das_batch_calls")
+        served = 0
+        with tracing.rpc_span(
+            "das_sample_batch", q.get("_tc"), cat="serving",
+            height=height, cells=len(coords),
+        ):
+            for i, part in enumerate(chunks):
+                weight = len({r for r, _ in part})
+                if not self.das_gate.try_acquire(weight=weight):
+                    telemetry.incr("das_batch_shed")
+                    tracing.instant("das_sample_batch.shed", cat="serving")
+                    yield json.dumps(
+                        {
+                            "shed": True,
+                            "retry_after_ms": self.das_gate.retry_after_ms,
+                            "served": served,
+                        }
+                    ).encode()
+                    return
+                try:
+                    faults.fire("server.sample")
+                    out = self.node.abci_query(
+                        "custom/das/sample_batch",
+                        {"height": height, "coords": part},
+                    )
+                    telemetry.incr("das_samples_served", len(part))
+                    served += len(part)
+                    yield json.dumps(
+                        {
+                            "shed": False,
+                            "done": i == len(chunks) - 1,
+                            **out,
+                        },
+                        default=str,
+                    ).encode()
+                except faults.InjectedFault as e:
+                    # reported retriable like shed load, but NOT counted
+                    # as shed: the shed counters track real gate
+                    # pressure (same rule as the single-cell handler),
+                    # so a chaos drill never inflates the das_shed
+                    # signal dashboards scale out on
+                    yield json.dumps(
+                        {
+                            "shed": True,
+                            "retry_after_ms": self.das_gate.retry_after_ms,
+                            "served": served,
+                            "log": str(e),
+                        }
+                    ).encode()
+                    return
+                except Exception as e:
+                    yield json.dumps({"code": 1, "log": str(e)}).encode()
+                    return
+                finally:
+                    self.das_gate.release(weight=weight)
 
     # -- observability plane (utils/telemetry.py + utils/tracing.py) ----
 
@@ -404,6 +516,29 @@ class NodeService:
         lines.append(
             f"celestia_tpu_mesh_fallback_squares_total "
             f"{ms['fallback_squares']}"
+        )
+        # DAS serving plane (da/das.py + the das_gate): admission stats
+        # as explicit gauges/counters (the das_rows cache's hits/misses
+        # already ride the unified cache registry lines with
+        # cache="das_rows"; the served/shed request counters ride the
+        # telemetry export as celestia_tpu_das_*_total) plus the rows
+        # hit rate as a ready-made gauge for dashboards/alerts
+        from celestia_tpu.da import das as das_mod
+
+        gate = self.das_gate.stats()
+        lines.append(f"celestia_tpu_das_gate_inflight {gate['inflight']}")
+        lines.append(
+            f"celestia_tpu_das_gate_max_inflight {gate['max_inflight']}"
+        )
+        lines.append("# TYPE celestia_tpu_das_gate_admitted_total counter")
+        lines.append(
+            f"celestia_tpu_das_gate_admitted_total {gate['admitted']}"
+        )
+        lines.append("# TYPE celestia_tpu_das_gate_shed_total counter")
+        lines.append(f"celestia_tpu_das_gate_shed_total {gate['shed']}")
+        rows = das_mod.rows_cache().stats()
+        lines.append(
+            f"celestia_tpu_das_rows_hit_rate {round(rows['hit_rate'], 6)}"
         )
         # trace-ring health (satellite: remote truncation detectability)
         rs = tracing.ring_stats()
@@ -806,6 +941,14 @@ class NodeService:
             )
             for name, fn in rpcs.items()
         }
+        # the one server-streaming method: a DAS batch arrives as ONE
+        # request and leaves as chunked responses (each independently
+        # gate-admitted), so a 10k-cell answer never materializes as a
+        # single JSON blob on either side of the wire
+        method_handlers["DasSampleBatch"] = grpc.unary_stream_rpc_method_handler(
+            self._counted_stream("DasSampleBatch", self.das_sample_batch),
+            request_deserializer=_identity, response_serializer=_identity
+        )
         return grpc.method_handlers_generic_handler(SERVICE, method_handlers)
 
     def _counted(self, name: str, fn):
@@ -828,6 +971,27 @@ class NodeService:
             resp = _fn(req, ctx)
             t.incr(f"{_p}_bytes_out", len(resp) if resp else 0)
             return resp
+
+        return handler
+
+    def _counted_stream(self, name: str, fn):
+        """The streaming-method twin of :meth:`_counted`: one ``_calls``
+        per stream, ``bytes_out`` accumulated per yielded message (the
+        telemetry is re-read per message for the same state-sync-restore
+        reason)."""
+        from celestia_tpu.utils.telemetry import snake_case
+
+        prefix = f"rpc_{snake_case(name)}"
+
+        def handler(req: bytes, ctx, _fn=fn, _p=prefix):
+            t = self.node.app.telemetry
+            t.incr(f"{_p}_calls")
+            t.incr(f"{_p}_bytes_in", len(req) if req else 0)
+            for resp in _fn(req, ctx):
+                self.node.app.telemetry.incr(
+                    f"{_p}_bytes_out", len(resp) if resp else 0
+                )
+                yield resp
 
         return handler
 

@@ -1125,6 +1125,23 @@ class TestNode:
                 "proof": proof.to_dict(),
                 "data_root": self.data_root(height).hex(),
             }
+        if path == "custom/das/sample_batch":
+            # vectorized serving surface: n cells in one row-grouped
+            # pass (shared row stacks + one root tree; da/das.py).
+            # Chunking/shedding live at the RPC layer (node/server.py
+            # DasSampleBatch) — this query proves whatever it is handed.
+            from celestia_tpu.da import das as das_mod
+
+            height = int(data["height"])
+            art = self._block_artifacts(height)
+            proofs = das_mod.sample_proofs_batch(
+                art["eds"], art["dah"],
+                [(int(r), int(c)) for r, c in data["coords"]],
+            )
+            return {
+                "proofs": [p.to_dict() for p in proofs],
+                "data_root": self.data_root(height).hex(),
+            }
         if path == "custom/proof/share":
             height = int(data["height"])
             art = self._block_artifacts(height)

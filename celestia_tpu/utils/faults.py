@@ -677,11 +677,18 @@ class BreakerRegistry:
 
 
 class LoadShedGate:
-    """Admit up to ``max_inflight`` concurrent requests; shed the rest
-    with a retry-after hint instead of queueing unboundedly.  Shedding
-    keeps the served requests fast (bounded queue => bounded latency)
-    and gives honest clients an explicit, retriable signal — the
-    serving plane degrades, it does not collapse."""
+    """Admit up to ``max_inflight`` concurrent units of work; shed the
+    rest with a retry-after hint instead of queueing unboundedly.
+    Shedding keeps the served requests fast (bounded queue => bounded
+    latency) and gives honest clients an explicit, retriable signal —
+    the serving plane degrades, it does not collapse.
+
+    Admission is WEIGHTED: a batch request passes its work size (the
+    DAS batch plane weighs a chunk by the distinct rows it proves), so
+    batching cannot launder n requests' load past a gate sized for
+    single-cell traffic.  An oversize weight (> ``max_inflight``) is
+    admitted only when the gate is fully idle — bounded overshoot beats
+    a request class that can never be served."""
 
     def __init__(self, max_inflight: int = 8, retry_after_ms: float = 25.0):
         self.max_inflight = max(1, int(max_inflight))
@@ -691,18 +698,21 @@ class LoadShedGate:
         self.admitted = 0  # celint: guarded-by(self._lock)
         self.shed = 0  # celint: guarded-by(self._lock)
 
-    def try_acquire(self) -> bool:
+    def try_acquire(self, weight: int = 1) -> bool:
+        weight = max(1, int(weight))
         with self._lock:
-            if self._inflight >= self.max_inflight:
+            if self._inflight > 0 and (
+                self._inflight + weight > self.max_inflight
+            ):
                 self.shed += 1
                 return False
-            self._inflight += 1
+            self._inflight += weight
             self.admitted += 1
             return True
 
-    def release(self) -> None:
+    def release(self, weight: int = 1) -> None:
         with self._lock:
-            self._inflight = max(0, self._inflight - 1)
+            self._inflight = max(0, self._inflight - max(1, int(weight)))
 
     def stats(self) -> dict:
         with self._lock:

@@ -22,7 +22,8 @@ the same bounded-structure discipline as the rest of the plane:
 * :func:`collect_node_sample` — the one snapshot builder: height,
   eds-cache hit rate, gossip breaker states, fault/degradation totals,
   trace-ring drops, device busy/occupancy + memory watermark
-  (utils/devprof.py), DAS shed count.
+  (utils/devprof.py), DAS serving health (shed + samples-served
+  counters, das_rows proof-cache hit rate).
 
 Operators extend the rule set declaratively via the
 ``CELESTIA_TPU_ALERT_RULES`` environment variable (a JSON list of rule
@@ -429,14 +430,26 @@ def collect_node_sample(node) -> Dict[str, float]:
                 values["device_mem_frac"] = float(mem["frac"])
             if "peak_frac" in mem:
                 values["device_mem_peak_frac"] = float(mem["peak_frac"])
-    # serving-plane pressure
+    # serving-plane pressure + throughput: shed and served counters so
+    # the stock rate rules can watch serving health, plus the das_rows
+    # hit rate (omitted until the cache has seen a counted lookup —
+    # same skip-absent contract as the eds rate)
     app = getattr(node, "app", None)
     telemetry = getattr(app, "telemetry", None)
     if telemetry is not None:
         counters, _g, _t = telemetry._snapshot()
-        values["das_shed"] = float(counters.get("das_sample_shed", 0))
+        values["das_shed"] = float(
+            counters.get("das_sample_shed", 0)
+            + counters.get("das_batch_shed", 0)
+        )
+        values["das_samples_served"] = float(
+            counters.get("das_samples_served", 0)
+        )
         values["blocks_prepared"] = float(
             counters.get("eds_cache_hit_prepare", 0)
             + counters.get("eds_cache_miss_prepare", 0)
         )
+    das_rows = reg["caches"].get("das_rows")
+    if das_rows is not None and (das_rows["hits"] + das_rows["misses"]) > 0:
+        values["das_rows_hit_rate"] = float(das_rows["hit_rate"])
     return values

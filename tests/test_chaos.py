@@ -257,6 +257,151 @@ def test_oversized_chunk_never_retried(chaos):
 # ---------------------------------------------------------------------------
 
 
+def test_saturated_node_sheds_batches_and_fraud_path_survives(chaos):
+    """The weighted shed gate vs the batch plane (ISSUE-15 satellite):
+    with the DAS gate saturated, an n-cell DasSampleBatch is SHED with
+    ``retry_after_ms`` — batching cannot launder load past the gate PR 7
+    built — the client's RetryPolicy resumes the remainder once capacity
+    frees, every resumed proof verifies, and the fraud pipeline keeps
+    working while the plane is under pressure."""
+    from celestia_tpu.client.remote import RemoteNode
+    from celestia_tpu.da import das as das_mod
+    from celestia_tpu.node.server import NodeServer
+    from celestia_tpu.node.testnode import TestNode
+
+    node = TestNode(auto_produce=False)
+    node.produce_block()
+    server = NodeServer(node, block_interval_s=None, das_max_inflight=2)
+    server.start()
+    try:
+        remote = RemoteNode(server.address, timeout_s=30.0)
+        try:
+            height = node.height
+            data_root = node.data_root(height)
+            k = node.block(height).header.square_size
+            coords = [
+                (i % (2 * k), (i // 2) % (2 * k)) for i in range(10)
+            ]
+            gate = server.service.das_gate
+
+            # saturate: hold the whole gate, as concurrent single-cell
+            # traffic would.  A batch must shed NOW, with the pushback
+            # hint — not queue, not partially serve
+            assert gate.try_acquire(weight=gate.max_inflight)
+            with pytest.raises(faults.Overloaded) as exc:
+                remote.das_sample_batch(
+                    height, coords,
+                    policy=faults.RetryPolicy(
+                        attempts=2, base_s=0.001, cap_s=0.005, seed=7
+                    ),
+                )
+            assert exc.value.retry_after_ms == gate.retry_after_ms
+            shed_before = gate.stats()["shed"]
+            assert shed_before > 0
+
+            # the fraud path still works while the plane sheds
+            rng = np.random.default_rng(31)
+            square = rng.integers(0, 256, (8, 8, 512), dtype=np.uint8)
+            square[:, :, :29] = 0
+            eds, dah = dah_mod.extend_and_header(square)
+            shares = np.array(np.asarray(eds.shares), copy=True)
+            shares[1, 9, 50] ^= 0x3C
+            bad_dah = dah_mod.new_data_availability_header(
+                ExtendedDataSquare(shares)
+            )
+            axis, idx = fraud.detect_bad_encoding(shares)
+            befp = fraud.build_befp(shares, axis, idx)
+            assert befp.verify(bad_dah)
+            assert not befp.verify(dah)
+
+            # capacity frees mid-retry: release the gate from a timer
+            # thread, and the SAME RetryPolicy-driven call resumes and
+            # completes — honest pushback costs a delay, never the batch
+            t = threading.Timer(
+                0.05, gate.release, kwargs={"weight": gate.max_inflight}
+            )
+            t.start()
+            try:
+                out = remote.das_sample_batch(
+                    height, coords,
+                    policy=faults.RetryPolicy(
+                        attempts=10, base_s=0.01, cap_s=0.05,
+                        deadline_s=20.0, seed=11,
+                    ),
+                )
+            finally:
+                t.join()
+            assert len(out["proofs"]) == len(coords)
+            assert bytes.fromhex(out["data_root"]) == data_root
+            for (r, c), d in zip(coords, out["proofs"]):
+                proof = das_mod.SampleProof.from_dict(d)
+                assert (proof.row, proof.col) == (r, c)
+                assert proof.verify(data_root)
+            # the shed was recorded on the serving plane's telemetry
+            counters, _g, _t = node.app.telemetry._snapshot()
+            assert counters.get("das_batch_shed", 0) > 0
+            assert counters.get("das_samples_served", 0) >= len(coords)
+        finally:
+            remote.close()
+    finally:
+        server.stop()
+
+
+def test_batch_admits_alongside_concurrent_traffic(chaos):
+    """A PARTIALLY loaded gate must still serve batches: chunk
+    boundaries keep the admission weight STRICTLY below max_inflight,
+    so a many-row batch never degenerates into the oversize-only-when-
+    idle path and starves behind ordinary single-cell traffic.
+
+    das_max_inflight=2 makes the boundary bite even on the k=1 block
+    (2 distinct rows): an uncapped chunk would weigh 2 and shed against
+    the held unit (1 + 2 > 2), so this test FAILS without the
+    max_inflight - 1 row cap — every chunk must weigh 1 and admit
+    alongside the concurrent request, no retry needed at all."""
+    from celestia_tpu.client.remote import RemoteNode
+    from celestia_tpu.da import das as das_mod
+    from celestia_tpu.node.server import NodeServer
+    from celestia_tpu.node.testnode import TestNode
+
+    node = TestNode(auto_produce=False)
+    node.produce_block()
+    server = NodeServer(node, block_interval_s=None, das_max_inflight=2)
+    server.start()
+    try:
+        remote = RemoteNode(server.address, timeout_s=30.0)
+        try:
+            height = node.height
+            data_root = node.data_root(height)
+            k = node.block(height).header.square_size
+            # more distinct rows than the capped chunk weight
+            coords = [(r % (2 * k), r % (2 * k)) for r in range(2 * k)] + [
+                (r % (2 * k), (r + 1) % (2 * k)) for r in range(2 * k)
+            ]
+            gate = server.service.das_gate
+            # one unit held by "someone else's" inflight single-cell
+            # request for the whole batch
+            assert gate.try_acquire()
+            try:
+                out = remote.das_sample_batch(
+                    height, coords,
+                    policy=faults.RetryPolicy(
+                        attempts=1, base_s=0.001, cap_s=0.01
+                    ),
+                )
+            finally:
+                gate.release()
+            assert len(out["proofs"]) == len(coords)
+            for (r, c), d in zip(coords, out["proofs"]):
+                proof = das_mod.SampleProof.from_dict(d)
+                assert (proof.row, proof.col) == (r, c)
+                assert proof.verify(data_root)
+            assert gate.stats()["shed"] == 0
+        finally:
+            remote.close()
+    finally:
+        server.stop()
+
+
 @pytest.mark.parametrize("seed", CHAOS_SEEDS)
 def test_fraud_proof_survives_saturated_faulted_node(seed, chaos):
     """ISSUE-7 acceptance: with faults armed on gossip.fetch,

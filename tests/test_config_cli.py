@@ -161,6 +161,16 @@ def test_cli_das_and_namespace_queries(tmp_path, capsys):
         ]) == 0
         out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert out["available"] and out["verified"] == 6
+        # the scalar route (--per-cell) draws the same verdict for the
+        # same seed — one DasSample RPC per cell instead of one batch
+        assert main([
+            "query", "--node", server.address, "--timeout", "120",
+            "das-sample", h, "--samples", "6", "--per-cell",
+        ]) == 0
+        out_pc = _json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1]
+        )
+        assert out_pc == out
         assert main([
             "query", "--node", server.address, "--timeout", "120",
             "namespace-shares", h, ns.raw.hex(),

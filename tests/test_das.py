@@ -126,6 +126,172 @@ def test_withheld_data_is_recoverable_iff_sampling_would_pass(block):
     assert np.array_equal(fixed, np.asarray(eds.shares))
 
 
+# ---------------------------------------------------------------------------
+# vectorized serving plane: batch prover byte-identity + das_rows cache
+# ---------------------------------------------------------------------------
+
+
+def _all_quadrant_coords(k):
+    n2 = 2 * k
+    return [
+        (0, 0), (1, 2), (1, k + 2), (k + 1, 2), (k + 1, k + 2),
+        (1, 3), (k, k), (n2 - 1, n2 - 1), (0, n2 - 1), (n2 - 1, 0),
+    ]
+
+
+def test_batch_proofs_byte_identical_across_quadrants(block):
+    """sample_proofs_batch emits proofs byte-identical to the per-cell
+    prover for every quadrant, in request order, repeated coords
+    included — cold AND warm (the cached row stack must reproduce the
+    exact same bytes as a fresh row pass)."""
+    eds, dah = block
+    k = eds.square_size
+    coords = _all_quadrant_coords(k) + [(1, 2)]  # repeat: same row+cell
+    das.rows_cache().clear()
+    cold = das.sample_proofs_batch(eds, dah, coords)
+    warm = das.sample_proofs_batch(eds, dah, coords)
+    for (r, c), pc, pw in zip(coords, cold, warm):
+        ref = das._sample_proof_uncached(eds, dah, r, c)
+        assert pc == ref, (r, c)
+        assert pw == ref, (r, c)
+        assert pc.verify(dah.hash)
+    # the warm pass hit every row it touched (and the root tree)
+    st = das.rows_cache().stats()
+    assert st["hits"] > 0
+
+
+@pytest.mark.parametrize("codec", ["leopard", "lagrange"])
+def test_batch_identity_both_codecs(codec):
+    """Byte-identity holds under BOTH share codecs (the parity bytes —
+    and therefore every parity-row stack — differ between them)."""
+    from celestia_tpu.ops import gf256
+
+    full = {"leopard": gf256.CODEC_LEOPARD, "lagrange": gf256.CODEC_LAGRANGE}[
+        codec
+    ]
+    prev = gf256.active_codec()
+    try:
+        gf256.set_active_codec(full)
+        rng = np.random.default_rng(21)
+        k = 4
+        square = rng.integers(0, 256, (k, k, 512), dtype=np.uint8)
+        square[:, :, :29] = 0
+        eds, dah = dah_mod.extend_and_header(square)
+        das.rows_cache().clear()
+        coords = _all_quadrant_coords(k)
+        batch = das.sample_proofs_batch(eds, dah, coords)
+        for (r, c), p in zip(coords, batch):
+            assert p == das._sample_proof_uncached(eds, dah, r, c), (r, c)
+            assert p.verify(dah.hash)
+    finally:
+        gf256.set_active_codec(prev)
+
+
+def test_scalar_sample_proof_reuses_warm_cache(block):
+    """The single-cell prover is a 1-cell batch: a warm row serves any
+    other cell of that row without a fresh row pass (miss count frozen),
+    and the proof still verifies."""
+    eds, dah = block
+    das.rows_cache().clear()
+    das.sample_proof(eds, dah, 3, 1)
+    misses = das.rows_cache().stats()["misses"]
+    p = das.sample_proof(eds, dah, 3, 7)  # same row, different cell
+    assert das.rows_cache().stats()["misses"] == misses
+    assert p.verify(dah.hash)
+    assert p == das._sample_proof_uncached(eds, dah, 3, 7)
+
+
+def test_tampered_cached_level_stack_cannot_prove(block):
+    """A corrupted das_rows entry (bit-flipped digest in the cached row
+    stack) can never yield a proof that verifies — the cache is an
+    accelerator, not a trust root."""
+    eds, dah = block
+    das.rows_cache().clear()
+    das.sample_proofs_batch(eds, dah, [(2, 3)])  # warm row 2
+    key = (dah.hash, 2)
+    stack = das.rows_cache().get(key)
+    assert stack is not None
+    tampered = [np.array(lv, copy=True) for lv in stack]
+    # flip a byte of the sampled cell's SIBLING leaf digest — a node the
+    # emitted proof actually carries (the in-range leaf itself is
+    # recomputed by the verifier from the share, never trusted)
+    tampered[0][2, 0] ^= 1
+    das.rows_cache().put(key, tampered)
+    bad = das.sample_proofs_batch(eds, dah, [(2, 3)])[0]
+    assert not bad.verify(dah.hash)
+    das.rows_cache().clear()  # don't leak the poisoned entry
+
+
+def test_mutated_share_cannot_prove_through_warm_cache(block):
+    """A provider that mutates a share AFTER warming the cache serves a
+    proof whose leaf no longer matches the committed row root."""
+    from celestia_tpu.da.dah import ExtendedDataSquare
+
+    eds, dah = block
+    das.rows_cache().clear()
+    das.sample_proofs_batch(eds, dah, [(1, 1)])  # warm row 1
+    shares = np.array(np.asarray(eds.shares), copy=True)
+    shares[1, 1, 100] ^= 0x5A
+    mutated = ExtendedDataSquare(shares)
+    bad = das.sample_proofs_batch(mutated, dah, [(1, 1)])[0]
+    assert not bad.verify(dah.hash)
+    das.rows_cache().clear()
+
+
+def test_wrong_data_root_key_never_serves(block):
+    """Entries are keyed by data root: a different block NEVER reads
+    another block's cached stacks — its proofs are computed fresh and
+    verify only under its own root."""
+    eds_a, dah_a = block
+    k = eds_a.square_size
+    eds_b, dah_b = dah_mod.extend_and_header(
+        np.zeros((k, k, 512), dtype=np.uint8)
+    )
+    assert dah_a.hash != dah_b.hash
+    das.rows_cache().clear()
+    das.sample_proofs_batch(eds_a, dah_a, _all_quadrant_coords(k))  # warm A
+    hits_after_a = das.rows_cache().stats()["hits"]
+    proofs_b = das.sample_proofs_batch(eds_b, dah_b, [(1, 2), (k + 1, 2)])
+    # B's pass hit nothing A cached (keys bind the root)
+    assert das.rows_cache().stats()["hits"] == hits_after_a
+    for p in proofs_b:
+        assert p.verify(dah_b.hash)
+        assert not p.verify(dah_a.hash)
+
+
+def test_batch_rejects_out_of_range_coordinate(block):
+    eds, dah = block
+    k = eds.square_size
+    with pytest.raises(ValueError, match="outside"):
+        das.sample_proofs_batch(eds, dah, [(0, 0), (2 * k, 0)])
+    assert das.sample_proofs_batch(eds, dah, []) == []
+
+
+def test_light_client_batch_fetch_routes_and_verifies(block):
+    """LightClient.sample(fetch_batch=...) draws once through the batch
+    plane; a short batch response counts the tail as withheld."""
+    eds, dah = block
+    lc = das.LightClient(dah.hash, eds.square_size, seed=42)
+    calls = []
+
+    def fetch_batch(coords):
+        calls.append(list(coords))
+        return das.sample_proofs_batch(eds, dah, coords)
+
+    result = lc.sample(fetch_batch=fetch_batch, n_samples=16)
+    assert result.available and result.verified == 16
+    assert len(calls) == 1 and len(calls[0]) == 16
+    # short response: the provider cannot shrink the sample
+    short = das.LightClient(dah.hash, eds.square_size, seed=43).sample(
+        fetch_batch=lambda cs: das.sample_proofs_batch(eds, dah, cs)[:-2],
+        n_samples=8,
+    )
+    assert not short.available
+    assert sum(1 for _, _, why in short.failed if why == "not served") == 2
+    with pytest.raises(ValueError, match="exactly one"):
+        lc.sample(lambda r, c: None, 4, fetch_batch=fetch_batch)
+
+
 def test_sampling_over_the_node_api():
     """DAS through the node's query surface: a light client that never
     touches the EDS directly."""
@@ -155,3 +321,17 @@ def test_sampling_over_the_node_api():
     result = lc.sample(fetch, 12)
     assert result.available, result.failed
     assert result.confidence > 0.96
+
+    # the batch query surface serves the same draw in ONE round trip,
+    # byte-identical to the per-cell route
+    def fetch_batch(coords):
+        out = node.abci_query(
+            "custom/das/sample_batch",
+            {"height": height, "coords": [[r, c] for r, c in coords]},
+        )
+        return [das.SampleProof.from_dict(d) for d in out["proofs"]]
+
+    lcb = das.LightClient(blk.header.data_hash, k, seed=9)
+    batch_result = lcb.sample(fetch_batch=fetch_batch, n_samples=12)
+    assert batch_result.available, batch_result.failed
+    assert batch_result.coordinates == result.coordinates

@@ -1,0 +1,54 @@
+"""In-process DA-serving-plane smoke (the tier-1 twin of `make
+das-smoke` / tools/das_smoke.py, same contract as test_profile_smoke /
+test_incident_smoke): a tiny-k node serves a chunked multi-cell
+DasSampleBatch over the real gRPC boundary — proofs verify against the
+data root and match the per-cell prover byte-for-byte, the das_rows
+cache answers the second pass warm, a saturated gate sheds with
+``retry_after_ms`` and the client resumes, and the exposition stays
+parse-valid with the ``celestia_tpu_das_*`` counters present — plus the
+continuous-telemetry leg: ``collect_node_sample`` picks up the
+samples-served counter and the das_rows hit rate, so the stock alert
+rules can watch serving health."""
+
+import importlib.util
+from pathlib import Path
+
+_spec = importlib.util.spec_from_file_location(
+    "das_smoke", Path(__file__).resolve().parent.parent / "tools" / "das_smoke.py"
+)
+das_smoke = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(das_smoke)
+
+
+def test_das_smoke_in_process(capsys):
+    assert das_smoke.main() == 0
+    out = capsys.readouterr().out
+    assert '"das_smoke": "ok"' in out
+
+
+def test_collect_node_sample_carries_serving_signals():
+    """The timeseries collector reports das_samples_served (counter, so
+    the stock rate rules apply) and the das_rows hit rate once the cache
+    has seen counted lookups — the flight recorder's bundles inherit
+    both for free through the exposition artifact."""
+    import numpy as np
+
+    from celestia_tpu.da import dah as dah_mod
+    from celestia_tpu.da import das as das_mod
+    from celestia_tpu.node.testnode import TestNode
+    from celestia_tpu.utils import timeseries
+
+    node = TestNode(auto_produce=False)
+    node.produce_block()
+    node.app.telemetry.incr("das_samples_served", 7)
+    das_mod.rows_cache().clear()
+    rng = np.random.default_rng(3)
+    square = rng.integers(0, 256, (4, 4, 512), dtype=np.uint8)
+    square[:, :, :29] = 0
+    eds, dah = dah_mod.extend_and_header(square)
+    das_mod.sample_proofs_batch(eds, dah, [(0, 0), (0, 1)])  # miss + hit mix
+    das_mod.sample_proofs_batch(eds, dah, [(0, 2)])
+    values = timeseries.collect_node_sample(node)
+    assert values["das_samples_served"] == 7.0
+    assert "das_shed" in values
+    assert 0.0 < values["das_rows_hit_rate"] <= 1.0

@@ -318,3 +318,25 @@ def test_load_shed_gate_bounds_inflight():
     }
     g.release()
     assert g.try_acquire()  # capacity frees as requests complete
+
+
+def test_load_shed_gate_weighted_admission():
+    """Batch admission is weighted by work size: a heavy batch cannot
+    launder past a gate that single-cell traffic is already filling, an
+    oversize batch is admitted only on an idle gate (bounded overshoot
+    beats permanent starvation), and release returns its exact weight."""
+    g = faults.LoadShedGate(max_inflight=4, retry_after_ms=10.0)
+    assert g.try_acquire(weight=3)
+    assert not g.try_acquire(weight=2)  # 3 + 2 > 4: shed
+    assert g.try_acquire(weight=1)  # exactly fills the gate
+    assert not g.try_acquire()
+    g.release(weight=1)
+    g.release(weight=3)
+    assert g.stats()["inflight"] == 0
+    # oversize weight: admitted when idle, shed once anything is inflight
+    assert g.try_acquire(weight=9)
+    assert g.stats()["inflight"] == 9
+    assert not g.try_acquire()
+    g.release(weight=9)
+    assert g.try_acquire() and not g.try_acquire(weight=9)
+    assert g.stats()["shed"] == 4

@@ -25,6 +25,9 @@ What counts as a headline metric (see BASELINE.md for meanings):
   skipped),
 * ``extras.device_profile.device_occupancy_pct`` (HIGHER is better —
   falling occupancy at equal work means growing dispatch gaps),
+* ``extras.das_serving``: every k-stamped ``*_samples_per_s`` figure and
+  the ``warm_batch_vs_scalar_*_speedup`` (HIGHER is better — the
+  serving plane's throughput trajectory),
 * ``extras.multichip`` (the sharded mesh series): every warm ``*_ms``
   figure (lower is better; ``*_cold_ms`` compile walls are recorded but
   not watched — single-run XLA compile is host-load noise) and every
@@ -132,6 +135,16 @@ def _flat_headlines(parsed: dict):
                     yield f"{series}.{mk}", float(mv), True
                 elif mk.endswith("_ms") and not mk.endswith("_cold_ms"):
                     yield f"{series}.{mk}", float(mv), False
+        elif key == "das_serving" and isinstance(val, dict):
+            # the serving plane's throughput series: samples/sec figures
+            # and the warm-batch-vs-scalar speedup are HIGHER-is-better;
+            # names carry the k stamp, so rounds at different square
+            # sizes never cross-compare
+            for mk, mv in sorted(val.items()):
+                if isinstance(mv, bool) or not isinstance(mv, (int, float)):
+                    continue
+                if mk.endswith("_samples_per_s") or mk.endswith("_speedup"):
+                    yield f"das_serving.{mk}", float(mv), True
         elif key == "device_profile" and isinstance(val, dict):
             occ = val.get("device_occupancy_pct")
             if isinstance(occ, (int, float)) and not isinstance(occ, bool):

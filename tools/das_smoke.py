@@ -1,0 +1,152 @@
+"""das-smoke: the vectorized DA serving plane's boot gate (`make das-smoke`).
+
+Drives a tiny-k node end-to-end over the REAL gRPC boundary:
+
+* a funded testnode commits one blob block, a NodeServer serves it, and
+  a RemoteNode client pulls a multi-cell DasSampleBatch (chunked, so the
+  stream path — not just the single-message fast case — is exercised);
+* every streamed proof must verify against the block's data root AND be
+  byte-identical to the per-cell prover's output for the same cell;
+* a second batch over the same coordinates must be served WARM: the
+  das_rows cache reports hits and the stream still verifies;
+* a saturated gate must shed the batch with ``retry_after_ms`` and the
+  RetryPolicy-driven client must resume once capacity frees;
+* the Prometheus exposition must stay line-parse-valid and carry the
+  serving plane's ``celestia_tpu_das_*`` counters.
+
+Exit 0 + one summary JSON line on success; non-zero with the reason on
+any failure.  Runs entirely on the CPU backend (tier-1 runs the same
+assertions in-process via tests/test_das_smoke.py).
+"""
+
+import json
+import os
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from celestia_tpu.client.signer import Signer
+    from celestia_tpu.da import das as das_mod
+    from celestia_tpu.da.blob import Blob
+    from celestia_tpu.da.namespace import Namespace
+    from celestia_tpu.node.remote import RemoteNode
+    from celestia_tpu.node.server import NodeServer
+    from celestia_tpu.node.testnode import TestNode
+    from celestia_tpu.utils import faults
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+    from celestia_tpu.utils.telemetry import validate_exposition
+
+    key = PrivateKey.from_seed(b"das-smoke")
+    node = TestNode(funded_accounts=[(key, 10**12)])
+    signer = Signer(node, key)
+    data = bytes(
+        np.random.default_rng(3).integers(0, 256, 4000, dtype=np.uint8)
+    )
+    res = signer.submit_pay_for_blob([Blob(Namespace.v0(b"\x2a" * 10), data)])
+    assert res.code == 0, f"blob submit failed: {res.log}"
+    height = res.height
+    blk = node.block(height)
+    k = blk.header.square_size
+    data_root = blk.header.data_hash
+
+    das_mod.rows_cache().clear()
+    server = NodeServer(node, block_interval_s=None)
+    server.start()
+    try:
+        remote = RemoteNode(server.address, timeout_s=30.0)
+        try:
+            lc = das_mod.LightClient(data_root, k, seed=9)
+            coords = lc.pick_coordinates(12)
+
+            # cold batch over the real RPC, chunked (chunk=5 forces >= 3
+            # stream messages for 12 cells)
+            out = remote.das_sample_batch(height, coords, chunk=5)
+            assert len(out["proofs"]) == len(coords), "short batch"
+            assert bytes.fromhex(out["data_root"]) == data_root
+            for (r, c), d in zip(coords, out["proofs"]):
+                proof = das_mod.SampleProof.from_dict(d)
+                assert (proof.row, proof.col) == (r, c), "coordinate mixup"
+                assert proof.verify(data_root), f"proof ({r},{c}) invalid"
+
+            # byte-identity vs the per-cell prover for one cell of the
+            # batch (the full cross-product is pinned by tests/test_das)
+            art = node._block_artifacts(height)
+            ref = das_mod._sample_proof_uncached(
+                art["eds"], art["dah"], *coords[0]
+            )
+            assert (
+                das_mod.SampleProof.from_dict(out["proofs"][0]) == ref
+            ), "batch proof not byte-identical to the per-cell prover"
+
+            # warm pass: the das_rows cache must serve hits
+            hits_before = das_mod.rows_cache().stats()["hits"]
+            out2 = remote.das_sample_batch(height, coords, chunk=5)
+            assert out2["proofs"] == out["proofs"], "warm pass diverged"
+            warm_hits = das_mod.rows_cache().stats()["hits"] - hits_before
+            assert warm_hits > 0, "warm batch hit nothing in das_rows"
+
+            # shed + resume: hold the whole gate, watch the pushback,
+            # free it from a timer and let the RetryPolicy resume
+            gate = server.service.das_gate
+            assert gate.try_acquire(weight=gate.max_inflight)
+            released = threading.Timer(
+                0.05, gate.release, kwargs={"weight": gate.max_inflight}
+            )
+            released.start()
+            try:
+                out3 = remote.das_sample_batch(
+                    height, coords,
+                    policy=faults.RetryPolicy(
+                        attempts=10, base_s=0.01, cap_s=0.05,
+                        deadline_s=20.0, seed=5,
+                    ),
+                )
+            finally:
+                released.join()
+            assert len(out3["proofs"]) == len(coords), "resume lost cells"
+            assert gate.stats()["shed"] > 0, "gate never shed"
+
+            # the exposition parses and carries the serving counters
+            text = server.service.metrics_text()
+            validate_exposition(text)
+            for needle in (
+                "celestia_tpu_das_samples_served_total",
+                "celestia_tpu_das_batch_calls_total",
+                "celestia_tpu_das_gate_shed_total",
+                "celestia_tpu_das_rows_hit_rate",
+            ):
+                assert needle in text, f"exposition missing {needle}"
+
+            print(
+                json.dumps(
+                    {
+                        "das_smoke": "ok",
+                        "k": k,
+                        "cells": len(coords),
+                        "warm_hits": warm_hits,
+                        "gate_shed": gate.stats()["shed"],
+                        "das_rows": das_mod.rows_cache().stats(),
+                    }
+                )
+            )
+            return 0
+        finally:
+            remote.close()
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,7 @@ PY ?= python
 # passes --format through; exit codes are unchanged either way
 LINT_FORMAT ?=
 
-.PHONY: lint lockwatch test chaos trace-smoke profile-smoke incident-smoke multichip-smoke das-smoke mesh-live t1-budget bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
+.PHONY: lint lockwatch test chaos trace-smoke profile-smoke incident-smoke multichip-smoke das-smoke device-resident-smoke mesh-live t1-budget bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
 
 ## celint: concurrency & determinism static analysis (exit 1 on findings)
 lint:
@@ -80,6 +80,17 @@ multichip-smoke:
 ## via tests/test_das_smoke.py)
 das-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/das_smoke.py
+
+## device-resident plane boot gate: one blob block prepared, processed
+## and DAS-served with the plane FORCED on — the committed block is
+## device-warm, every batched proof is byte-identical to the host
+## reference, the merged transfer ledger shows no hot-path D2H beyond
+## the data-root fetch + axis-roots fetch + proof-path gather, and
+## celint R7 passes with zero host-sync allows in da/device_plane.py
+## (tier-1 runs the same assertions via
+## tests/test_device_resident_smoke.py)
+device-resident-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/device_resident_smoke.py
 
 ## full live mesh-path suite (slow tier: each subprocess child pays one
 ## ~35-60 s structure-bound XLA CPU shard_map compile, over the 30 s

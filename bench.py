@@ -734,6 +734,90 @@ def _device_profile_extras(k: int) -> dict:
     return prof
 
 
+def _transfer_accounting_extras(k: int) -> dict:
+    """extras.transfer_accounting (BASELINE.md): per-leg H2D/D2H bytes,
+    ms and event counts through the device-resident plane
+    (da/device_plane.py), recorded by the devprof transfer ledger around
+    one cold extend and one device-warm batched DAS serve.
+
+    The plane is FORCED on for the leg (on the CPU fallback round it
+    would otherwise stay off), so the figures always describe the
+    device-resident wiring: the extend phase should charge one square
+    upload (h2d) plus the data-root + axis-roots fetches (d2h), and the
+    warm serve phase should charge ONLY the batched proof-path gather —
+    ``hot_path_d2h_legs`` lists every leg that crossed, which is how
+    bench_check sees a new unplanned transfer sneak onto the hot path."""
+    from celestia_tpu.da import dah as dah_mod
+    from celestia_tpu.da import das as das_mod
+    from celestia_tpu.da import device_plane, eds_cache
+    from celestia_tpu.utils import devprof
+
+    rng = np.random.default_rng(7)
+    sq = rng.integers(0, 256, (k, k, 512), dtype=np.uint8)
+    sq[:, :, :29] = 0
+    sq[:, :, 28] = rng.integers(1, 200, (k, k), dtype=np.uint8)
+    n2 = 2 * k
+    coord_rng = np.random.default_rng(8)
+    coords = [
+        (int(r), int(c))
+        for r, c in zip(
+            coord_rng.integers(0, n2, 64), coord_rng.integers(0, n2, 64)
+        )
+    ]
+    with device_plane.forced("on"):
+        if device_plane.poisoned() is not None:
+            return {"skipped": f"plane poisoned: {device_plane.poisoned()}"}
+        # warm the executables OUTSIDE the ledger window: the one-time
+        # compile is not a per-call transfer
+        eds_w, dah_w = dah_mod.extend_and_header(sq.copy())
+        das_mod.sample_proofs_batch(eds_w, dah_w, coords)
+        devprof.reset()
+        with devprof.collect():
+            t0 = time.time()
+            eds, dah = dah_mod.extend_and_header(sq.copy())
+            extend_ms = (time.time() - t0) * 1000.0
+            extend_legs = devprof.transfer_accounting()
+            t0 = time.time()
+            proofs = das_mod.sample_proofs_batch(eds, dah, coords)
+            serve_ms = (time.time() - t0) * 1000.0
+            all_legs = devprof.transfer_accounting()
+        if device_plane.poisoned() is not None:
+            return {"skipped": f"plane poisoned: {device_plane.poisoned()}"}
+        # byte-identity spot check: the ledger must never be the cost of
+        # a wrong proof (full cross-product pinned by the tier-1 tests)
+        ref = das_mod._sample_proof_uncached(eds, dah, *coords[0])
+        assert proofs[0] == ref, "device-served proof diverged"
+    serve_legs = {
+        leg: rec for leg, rec in all_legs.items()
+        if rec != extend_legs.get(leg)
+    }
+    out = {
+        "k": k,
+        "cells": len(coords),
+        "extend_cold_ms": round(extend_ms, 2),
+        "proof_serve_warm_ms": round(serve_ms, 2),
+        "legs": all_legs,
+        "hot_path_d2h_legs": sorted(
+            leg for leg, rec in all_legs.items() if rec["d2h_events"]
+        ),
+        "extend_d2h_bytes": sum(
+            rec["d2h_bytes"] for rec in extend_legs.values()
+        ),
+        "proof_serve_d2h_bytes": sum(
+            rec["d2h_bytes"] - extend_legs.get(leg, {}).get("d2h_bytes", 0)
+            for leg, rec in serve_legs.items()
+        ),
+        "total_d2h_bytes": sum(
+            rec["d2h_bytes"] for rec in all_legs.values()
+        ),
+        "total_h2d_bytes": sum(
+            rec["h2d_bytes"] for rec in all_legs.values()
+        ),
+        "device_cache": eds_cache.device_handle_stats(),
+    }
+    return out
+
+
 def _multichip_child_main() -> None:
     """extras.multichip child: sharded vs unsharded extend + the batched
     multi-block leg on THIS process's mesh (the parent prepared the
@@ -1332,6 +1416,13 @@ def _host_only_main():
     except Exception as e:
         extras["das_serving_error"] = repr(e)[:200]
     try:
+        # device-resident plane ledger on the XLA CPU backend at a tiny
+        # k (forced on — the CPU-compile wall makes full k infeasible):
+        # same wiring, same legs, host-scale byte figures
+        extras["transfer_accounting"] = _transfer_accounting_extras(4)
+    except Exception as e:
+        extras["transfer_accounting_error"] = repr(e)[:200]
+    try:
         # LAST: snapshot after every leg has exercised its caches
         extras["unified_caches"] = _unified_cache_stats()
     except Exception as e:
@@ -1517,6 +1608,13 @@ def main():
         extras["das_serving"] = _das_serving_extras(k)
     except Exception as e:
         extras["das_serving_error"] = repr(e)[:200]
+    try:
+        # device-resident plane ledger: per-leg H2D/D2H bytes + ms for
+        # extend vs device-warm proof serving (bench_check watches the
+        # byte/ms figures like compute regressions)
+        extras["transfer_accounting"] = _transfer_accounting_extras(k)
+    except Exception as e:
+        extras["transfer_accounting_error"] = repr(e)[:200]
     try:
         # LAST: snapshot after every leg has exercised its caches
         extras["unified_caches"] = _unified_cache_stats()

@@ -535,6 +535,21 @@ def extend_and_header(
 
     square = np.asarray(square, dtype=np.uint8)
     k = square.shape[0]
+    from celestia_tpu.da import device_plane
+
+    if device_plane.enabled():
+        # device-resident plane (specs/device_pipeline.md): one donated-
+        # buffer program emits EDS + NMT level stacks + root tree; only
+        # the data root and the 4k axis roots cross to the host, and the
+        # level stacks stay cached device-side for DAS serving.  First in
+        # the routing order so forcing the plane on (tests, smoke) wins
+        # over the host-regime fast paths; any fault poisons the plane
+        # one-way and THIS call falls through to the byte-identical legs
+        # below.
+        try:
+            return device_plane.extend_and_header(square)
+        except Exception as e:
+            device_plane.poison(f"device-resident extend failed: {e!r}")
     digests: Optional[List[bytes]] = None
     if host_regime() and _row_memo_applicable():
         with tracing.span("row_digests", k=k):

@@ -336,6 +336,25 @@ def sample_proofs_batch(
     if not coords:
         return []
     data_root = dah.hash
+    # device-resident serving (da/device_plane.py): if this block's
+    # level stacks are still on their chip (the proposer's own block at
+    # process/commit time, or any block extended through the plane), a
+    # proof is an index computation plus ONE batched device_get of the
+    # proof paths — no row rebuild, no re-hash.  Byte-identical to the
+    # host prover below (pinned by tests/test_device_plane.py); any
+    # device fault poisons the plane one-way and THIS batch falls
+    # through to the host path.
+    from celestia_tpu.da import device_plane, eds_cache
+
+    if device_plane.enabled():
+        dev_entry = eds_cache.get_device_entry(data_root)
+        if dev_entry is not None and dev_entry.k == k:
+            try:
+                return device_plane.sample_proofs_batch(
+                    dev_entry, dah, coords
+                )
+            except Exception as e:
+                device_plane.poison(f"device proof gather failed: {e!r}")
     all_roots = list(dah.row_roots) + list(dah.col_roots)
     total = len(all_roots)
     # root-proof material: one balanced level tree per block (4k is a

@@ -149,7 +149,64 @@ def put(key: bytes, eds, dah) -> None:
 
 def clear() -> None:
     CACHE.clear()
+    _DEVICE_CACHE.clear()
 
 
 def stats() -> dict:
     return CACHE.stats()
+
+
+# ---------------------------------------------------------------------------
+# Device-buffer handle companion cache (da/device_plane.py)
+# ---------------------------------------------------------------------------
+# Beside each content-addressed (eds, dah) pair, the device-resident
+# plane parks a DevicePlaneEntry — the SAME block's EDS, NMT level
+# stacks and root-tree levels still on their chip — keyed by data_root,
+# which is what process/commit and DAS serving hold when they come
+# looking.  Keying by data_root is safe here precisely because it is
+# NOT safe above: entries are inserted only after an honest local
+# computation produced that root, and a miss (eviction, byzantine
+# root, device loss) degrades to the byte-identical host path — never
+# to trusting a claimed root.
+#
+# The byte budget is explicit and conservative: a k=128 entry weighs
+# ~56 MiB of HBM (32 MiB shares + ~24 MiB digest levels), so the
+# defaults hold the prepare->process->commit lifecycle of the current
+# height plus one re-proposal.  Entry weights come from array shapes
+# (DevicePlaneEntry.nbytes) — weighing never forces a transfer.
+
+DEFAULT_DEVICE_ENTRIES = int(os.environ.get("CELESTIA_TPU_EDS_DEVICE", "4"))
+DEFAULT_DEVICE_MB = int(os.environ.get("CELESTIA_TPU_EDS_DEVICE_MB", "256"))
+
+_DEVICE_CACHE = LruCache(
+    "eds_device",
+    DEFAULT_DEVICE_ENTRIES,
+    weigher=lambda _key, entry: int(getattr(entry, "nbytes", 0)),
+    max_bytes=DEFAULT_DEVICE_MB * (1 << 20),
+)
+
+
+def put_device_entry(data_root: bytes, entry) -> None:
+    """Park a DevicePlaneEntry for ``data_root`` (evicts LRU handles
+    beyond the entry/byte budget; the dropped blocks become plain host-
+    path misses)."""
+    _DEVICE_CACHE.put(bytes(data_root), entry)
+
+
+def get_device_entry(data_root: bytes):
+    """The device-warm handle for ``data_root``, or None (evicted /
+    never proposed here / plane disabled) — None means host fallback."""
+    return _DEVICE_CACHE.get(bytes(data_root))
+
+
+_DROP_MISS = object()
+
+
+def drop_device_entry(data_root: bytes) -> bool:
+    """Evict one handle (device-loss handling, tests).  True if it was
+    resident."""
+    return _DEVICE_CACHE.pop(bytes(data_root), _DROP_MISS) is not _DROP_MISS
+
+
+def device_handle_stats() -> dict:
+    return _DEVICE_CACHE.stats()

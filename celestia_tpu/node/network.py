@@ -396,10 +396,16 @@ class ValidatorNetwork:
             for i in range(0, len(items), batch):
                 chunk = items[i : i + batch]
                 stacked = np.stack([sq for _, sq in chunk])
-                eds_b = np.asarray(rs.extend_squares_batched(stacked))
-                roots_b = np.asarray(
-                    __import__("jax").vmap(nmt_ops.eds_nmt_roots)(eds_b)
-                )
+                # the extended squares stay on device — root reduction
+                # runs on the device value and only the 90-byte roots
+                # cross, in ONE batched fetch (the two sequential
+                # np.asarray round trips this replaces pulled the whole
+                # EDS batch host-side just to discard it)
+                import jax
+
+                eds_b = rs.extend_squares_batched(stacked)
+                roots_dev = jax.vmap(nmt_ops.eds_nmt_roots)(eds_b)
+                (roots_b,) = jax.device_get((roots_dev,))
                 for (h, _), roots in zip(chunk, roots_b):
                     all_roots = roots.reshape(-1, 90)
                     droot = bytes(

@@ -284,6 +284,25 @@ def rfc6962_root_pow2(leaves: jnp.ndarray) -> jnp.ndarray:
     return nodes[..., 0, :]
 
 
+def rfc6962_level_stack(leaves: jnp.ndarray) -> list:
+    """All levels of the RFC-6962 tree over a power-of-two leaf count:
+    ``[leaf hashes (..., n, 32), (..., n/2, 32), ..., root (..., 1, 32)]``.
+
+    Traceable twin of da/proof.py's host ``merkle_level_tree`` (pinned
+    byte-identical by tests/test_device_plane.py) — the device-resident
+    plane keeps this stack on-chip so a data-root membership proof is a
+    gather of ``levels[j][(index >> j) ^ 1]``, never a re-hash.
+    """
+    n = leaves.shape[-2]
+    if n & (n - 1):
+        raise ValueError(f"leaf count must be a power of two, got {n}")
+    levels = [rfc6962_leaf_hashes(leaves)]
+    while levels[-1].shape[-2] > 1:
+        nodes = levels[-1]
+        levels.append(rfc6962_inner(nodes[..., 0::2, :], nodes[..., 1::2, :]))
+    return levels
+
+
 def rfc6962_root_np(leaves: list) -> np.ndarray:
     """Host reference for arbitrary leaf counts (tendermint split rule:
     largest power of two strictly less than n)."""

@@ -209,18 +209,16 @@ def _sharded_fn(mesh: Mesh, k: int, batched: bool, codec: str):
     return fn
 
 
-def _reassemble_eds(eds_local: np.ndarray, k: int) -> np.ndarray:
-    """(k, 2, 2k, B) row-shard layout -> (2k, 2k, B)."""
-    top = eds_local[:, 0]  # (k, 2k, B)
-    bot = eds_local[:, 1]
-    return np.concatenate([top, bot], axis=0)
-
-
-def extend_and_roots_sharded(
+def _extend_and_roots_sharded_device(
     square: np.ndarray, mesh: Mesh, *, record_stats: bool = True
 ):
-    """Sharded fused hot path on a mesh: square uint8[k,k,512] ->
-    (eds uint8[2k,2k,512], row_roots, col_roots, data_root).
+    """Sharded fused hot path on a mesh, DEVICE-RESIDENT results:
+    square uint8[k,k,512] -> (eds_dev uint8[2k,2k,512], row_roots,
+    col_roots, data_root) — all four still on their chips.  The
+    reassembly from the (k, 2, 2k, B) row-shard layout happens with a
+    device-side concatenate, so the header paths below never pull the
+    shares host-side at all (da/device_plane.py contract: the only D2H
+    of the proposal path is the roots).
 
     Instrumented: an ``extend.sharded`` span with the mesh shape as args
     (the live-path trace names the factoring) and a devprof dispatch
@@ -239,6 +237,7 @@ def extend_and_roots_sharded(
     ):
         sharding = NamedSharding(mesh, P("row", None, None))
         x = jax.device_put(jnp.asarray(square), sharding)
+        devprof.record_transfer("extend_sharded", "h2d", int(square.nbytes))
         fn = _sharded_fn(mesh, k, False, codec)
         d = devprof.dispatch(
             "extend_sharded", multi_device=True,
@@ -246,12 +245,10 @@ def extend_and_roots_sharded(
         )
         out = d.done(fn(x))
         eds_local, row_roots, col_roots, data_root = out
-        eds = _reassemble_eds(np.asarray(eds_local), k)
-        result = (
-            eds,
-            np.asarray(row_roots),
-            np.asarray(col_roots),
-            np.asarray(data_root),
+        # device-side reassembly of the row-shard layout (the old host
+        # np.concatenate reassembly cost two PCIe crossings per square)
+        eds_dev = jnp.concatenate(
+            [eds_local[:, 0], eds_local[:, 1]], axis=0
         )
     # cost accounting OUTSIDE the traced span (same placement contract
     # as da/dah.py): the one-time AOT compile lands in the
@@ -261,16 +258,38 @@ def extend_and_roots_sharded(
         from celestia_tpu.parallel import mesh as mesh_mod
 
         mesh_mod.record_sharded_extend()
-    return result
+    return eds_dev, row_roots, col_roots, data_root
 
 
-def extend_and_roots_sharded_batch(
+def extend_and_roots_sharded(
+    square: np.ndarray, mesh: Mesh, *, record_stats: bool = True
+):
+    """Sharded fused hot path on a mesh: square uint8[k,k,512] ->
+    (eds uint8[2k,2k,512], row_roots, col_roots, data_root) as HOST
+    arrays (the legacy contract).  All four results cross in ONE
+    batched ``device_get`` — callers that can keep the EDS on device
+    should use :func:`extend_and_header_sharded` instead, which fetches
+    only the roots."""
+    eds_dev, row_roots, col_roots, data_root = (
+        _extend_and_roots_sharded_device(
+            square, mesh, record_stats=record_stats
+        )
+    )
+    with tracing.span("roots", stage="fetch", sharded=True):
+        return devprof.fetch(
+            "sharded_results", (eds_dev, row_roots, col_roots, data_root)
+        )
+
+
+def _extend_and_roots_sharded_batch_device(
     squares: np.ndarray, mesh: Mesh, *, count_squares: int = None
 ):
-    """Batched sharded path: uint8[n, k, k, 512], n divisible by the data
-    axis -> (eds[n,2k,2k,512], row_roots[n,2k,90], col_roots[n,2k,90],
-    data_roots[n,32]).  One device dispatch for the whole batch — the
-    state-sync catch-up leg (BASELINE.json config #5).
+    """Batched sharded path, DEVICE-RESIDENT results: uint8[n,k,k,512],
+    n divisible by the data axis -> (eds_dev[n,2k,2k,512],
+    row_roots[n,2k,90], col_roots[n,2k,90], data_roots[n,32]) with all
+    four still on their chips (per-square reassembly is one device-side
+    concatenate over the whole batch).  One device dispatch for the
+    whole batch — the state-sync catch-up leg (BASELINE.json config #5).
 
     ``count_squares``: how many of the n inputs are REAL squares (the
     rest are data-axis padding the caller will drop) — only the real
@@ -285,6 +304,9 @@ def extend_and_roots_sharded_batch(
     ):
         sharding = NamedSharding(mesh, P("data", "row", None, None))
         x = jax.device_put(jnp.asarray(squares), sharding)
+        devprof.record_transfer(
+            "extend_sharded_batch", "h2d", int(squares.nbytes)
+        )
         fn = _sharded_fn(mesh, k, True, codec)
         d = devprof.dispatch(
             "extend_sharded_batch", multi_device=True,
@@ -292,13 +314,9 @@ def extend_and_roots_sharded_batch(
         )
         out = d.done(fn(x))
         eds_local, row_roots, col_roots, data_roots = out
-        eds_local = np.asarray(eds_local)
-        eds = np.stack([_reassemble_eds(eds_local[i], k) for i in range(n)])
-        result = (
-            eds,
-            np.asarray(row_roots),
-            np.asarray(col_roots),
-            np.asarray(data_roots),
+        # (n, k, 2, 2k, B) row-shard layout -> (n, 2k, 2k, B), on device
+        eds_dev = jnp.concatenate(
+            [eds_local[:, :, 0], eds_local[:, :, 1]], axis=1
         )
     devprof.note_compile("extend_sharded_batch", fn, (x,))
     from celestia_tpu.parallel import mesh as mesh_mod
@@ -306,7 +324,24 @@ def extend_and_roots_sharded_batch(
     mesh_mod.record_sharded_extend(
         batched=True, squares=n if count_squares is None else count_squares
     )
-    return result
+    return eds_dev, row_roots, col_roots, data_roots
+
+
+def extend_and_roots_sharded_batch(
+    squares: np.ndarray, mesh: Mesh, *, count_squares: int = None
+):
+    """Batched sharded path with the legacy HOST-array contract (see
+    :func:`_extend_and_roots_sharded_batch_device`): all four results
+    cross in ONE batched ``device_get``."""
+    eds_dev, row_roots, col_roots, data_roots = (
+        _extend_and_roots_sharded_batch_device(
+            squares, mesh, count_squares=count_squares
+        )
+    )
+    with tracing.span("roots", stage="fetch", sharded=True):
+        return devprof.fetch(
+            "sharded_results", (eds_dev, row_roots, col_roots, data_roots)
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -336,12 +371,15 @@ def extend_and_header_sharded(square: np.ndarray, mesh: Mesh):
     the single-device path (the consensus-safety requirement)."""
     from celestia_tpu.da.dah import ExtendedDataSquare
 
-    eds, row_roots, col_roots, data_root = extend_and_roots_sharded(
-        square, mesh
+    eds_dev, row_roots, col_roots, data_root = (
+        _extend_and_roots_sharded_device(square, mesh)
     )
-    return ExtendedDataSquare(eds), _header_from_roots(
-        row_roots, col_roots, data_root
+    # only the roots cross (one batched fetch, ~4k x 90 + 32 bytes);
+    # the EDS stays sharded on its chips until .shares is actually read
+    rr, cc, dr = devprof.fetch(
+        "sharded_roots", (row_roots, col_roots, data_root)
     )
+    return ExtendedDataSquare(eds_dev), _header_from_roots(rr, cc, dr)
 
 
 def extend_block_sharded(square, mesh: Mesh):
@@ -364,17 +402,22 @@ def extend_and_headers_sharded_batch(
     """
     from celestia_tpu.da.dah import ExtendedDataSquare
 
-    eds, row_roots, col_roots, data_roots = extend_and_roots_sharded_batch(
-        squares, mesh, count_squares=count_squares
+    eds_dev, row_roots, col_roots, data_roots = (
+        _extend_and_roots_sharded_batch_device(
+            squares, mesh, count_squares=count_squares
+        )
+    )
+    # one batched root fetch for the WHOLE warm batch; each square's
+    # shares stay device-resident until someone reads them
+    rr, cc, drs = devprof.fetch(
+        "sharded_roots", (row_roots, col_roots, data_roots)
     )
     out: List[Tuple[object, object]] = []
-    for i in range(eds.shape[0]):
+    for i in range(eds_dev.shape[0]):
         out.append(
             (
-                ExtendedDataSquare(eds[i]),
-                _header_from_roots(
-                    row_roots[i], col_roots[i], data_roots[i]
-                ),
+                ExtendedDataSquare(eds_dev[i]),
+                _header_from_roots(rr[i], cc[i], drs[i]),
             )
         )
     return out

@@ -94,6 +94,10 @@ _kernels: Dict[str, dict] = {}
 _notes: Dict[str, dict] = {}
 # per-dispatch-name duration histograms; celint: guarded-by(_lock)
 _dispatch_hist: Dict[str, Log2Histogram] = {}
+# per-leg H2D/D2H transfer accounting (bytes + ms + event counts);
+# celint: guarded-by(_lock)
+_transfers: Dict[str, dict] = {}
+_MAX_TRANSFER_LEGS = 64
 # last sampled memory watermark; celint: guarded-by(_lock)
 _mem: Optional[dict] = None
 # previous occupancy probe (ts, summed busy seconds) for the
@@ -141,6 +145,7 @@ def reset() -> None:
         _kernels.clear()
         _notes.clear()
         _dispatch_hist.clear()
+        _transfers.clear()
         _mem = None
         _probe_prev = None
         _window_t0 = clock()
@@ -387,6 +392,75 @@ def dispatch(name: str, multi_device: bool = False, **args) -> Any:
     if not active():
         return NULL_DISPATCH
     return Dispatch(name, args, multi=multi_device)
+
+
+# ---------------------------------------------------------------------------
+# H2D/D2H transfer accounting (the device-resident plane's ledger)
+# ---------------------------------------------------------------------------
+
+
+def record_transfer(
+    leg: str, direction: str, nbytes: int, ms: float = 0.0
+) -> None:
+    """Charge one host<->device crossing to a named leg (``direction`` is
+    ``"h2d"`` or ``"d2h"``).  Bytes are computed by the caller from array
+    SHAPES — recording a transfer must never itself force one.  Inactive
+    (no tracer, no :func:`collect` window), this is a no-op: the hot path
+    pays one call + a bool."""
+    if not active():
+        return
+    if direction not in ("h2d", "d2h"):
+        raise ValueError(f"direction must be h2d/d2h, got {direction!r}")
+    with _lock:
+        rec = _transfers.get(leg)
+        if rec is None:
+            if len(_transfers) >= _MAX_TRANSFER_LEGS:
+                return
+            rec = _transfers[leg] = {
+                "h2d_bytes": 0, "h2d_ms": 0.0, "h2d_events": 0,
+                "d2h_bytes": 0, "d2h_ms": 0.0, "d2h_events": 0,
+            }
+        rec[f"{direction}_bytes"] += int(nbytes)
+        rec[f"{direction}_ms"] += float(ms)
+        rec[f"{direction}_events"] += 1
+
+
+def fetch(leg: str, values):
+    """``jax.device_get`` with transfer accounting: ONE batched D2H fetch
+    of the whole pytree, charged to ``leg`` with its measured wall ms and
+    the fetched byte count.  The sanctioned bulk-fetch primitive of the
+    device-resident plane — per-array ``np.asarray`` pays a round trip
+    each AND is invisible to the transfer ledger."""
+    import jax
+
+    if not active():
+        return jax.device_get(values)
+    t0 = clock()
+    out = jax.device_get(values)
+    ms = (clock() - t0) * 1000.0
+    nbytes = 0
+    try:
+        for leaf in jax.tree_util.tree_leaves(out):
+            nbytes += int(getattr(leaf, "nbytes", 0) or 0)
+    except Exception as e:
+        note("transfer_nbytes", e)
+    record_transfer(leg, "d2h", nbytes, ms)
+    return out
+
+
+def transfer_accounting() -> Dict[str, dict]:
+    """Per-leg transfer ledger snapshot:
+    ``{leg: {h2d_bytes, h2d_ms, h2d_events, d2h_bytes, d2h_ms,
+    d2h_events}}`` (bench ``extras.transfer_accounting`` + the
+    device-resident smoke's only-sanctioned-D2H assertion)."""
+    with _lock:
+        return {
+            leg: {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in rec.items()
+            }
+            for leg, rec in sorted(_transfers.items())
+        }
 
 
 # ---------------------------------------------------------------------------

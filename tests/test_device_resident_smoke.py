@@ -1,0 +1,27 @@
+"""In-process device-resident-plane smoke (the tier-1 twin of `make
+device-resident-smoke` / tools/device_resident_smoke.py, same contract
+as test_das_smoke): one blob block prepared, processed and DAS-served
+with the plane FORCED on over the CPU backend — the committed block is
+device-warm in the eds_cache device-handle budget, every batched proof
+is byte-identical to the host reference, the merged devprof transfer
+ledger shows no hot-path D2H beyond the data-root fetch + axis-roots
+fetch + batched proof-path gather, and celint R7 passes with zero
+host-sync allow pragmas in da/device_plane.py."""
+
+import importlib.util
+from pathlib import Path
+
+_spec = importlib.util.spec_from_file_location(
+    "device_resident_smoke",
+    Path(__file__).resolve().parent.parent
+    / "tools"
+    / "device_resident_smoke.py",
+)
+device_resident_smoke = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(device_resident_smoke)
+
+
+def test_device_resident_smoke_in_process(capsys):
+    assert device_resident_smoke.main() == 0
+    out = capsys.readouterr().out
+    assert '"device_resident_smoke": "ok"' in out

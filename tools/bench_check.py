@@ -156,6 +156,24 @@ def _flat_headlines(parsed: dict):
             ov = val.get("sampler_overhead_pct")
             if isinstance(ov, (int, float)) and not isinstance(ov, bool):
                 yield "host_profile.sampler_overhead_pct", float(ov), False
+        elif key == "transfer_accounting" and isinstance(val, dict):
+            # the device-resident plane's transfer ledger: residual
+            # bytes over the wire and the two phase walls are watched
+            # like compute regressions (a new hot-path D2H shows up as
+            # a byte jump before it shows up as latency); the k stamp
+            # keeps host-fallback tiny-k rounds off the full-k series
+            kk = val.get("k", "nok")
+            for mk in (
+                "extend_cold_ms",
+                "proof_serve_warm_ms",
+                "extend_d2h_bytes",
+                "proof_serve_d2h_bytes",
+                "total_d2h_bytes",
+                "total_h2d_bytes",
+            ):
+                mv = val.get(mk)
+                if isinstance(mv, (int, float)) and not isinstance(mv, bool):
+                    yield f"transfer_accounting.k{kk}.{mk}", float(mv), False
         elif key == "lint_stats" and isinstance(val, dict):
             # celint whole-tree wall time: the R6 whole-program pass is
             # the only tier-1 gate whose cost grows with the TREE, so

@@ -7,7 +7,7 @@ PY ?= python
 # passes --format through; exit codes are unchanged either way
 LINT_FORMAT ?=
 
-.PHONY: lint lockwatch test chaos trace-smoke profile-smoke incident-smoke multichip-smoke das-smoke device-resident-smoke mesh-live t1-budget bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
+.PHONY: lint lockwatch test chaos trace-smoke profile-smoke incident-smoke multichip-smoke das-smoke swarm-smoke device-resident-smoke mesh-live t1-budget bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
 
 ## celint: concurrency & determinism static analysis (exit 1 on findings)
 lint:
@@ -80,6 +80,16 @@ multichip-smoke:
 ## via tests/test_das_smoke.py)
 das-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/das_smoke.py
+
+## swarm-scale serving crowd gate: ~64 seeded light clients (8 hostile
+## over-askers) drive one live QoS-enabled node — light-tier p99 stays
+## bounded and lane reservation holds while the hostile flood is demoted
+## and shed, per-peer/per-lane exposition lines parse, and the
+## swarm-induced fairness collapse fires das_fairness_floor whose
+## transition dumps a valid flight-recorder incident bundle (tier-1 runs
+## the same assertions via tests/test_swarm_smoke.py)
+swarm-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/swarm_smoke.py
 
 ## device-resident plane boot gate: one blob block prepared, processed
 ## and DAS-served with the plane FORCED on — the committed block is

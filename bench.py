@@ -1243,6 +1243,97 @@ def _das_serving_extras(k: int, n_samples: int = 256) -> dict:
     return out
 
 
+def _swarm_extras() -> dict:
+    """extras.swarm (BASELINE.md): the light-client swarm legs against
+    one live QoS-enabled node over the real gRPC boundary.  Two seeded
+    legs: an HONEST crowd (no over-askers — the per-tier latency tails
+    and the Jain fairness index bench_check judges against the 0.8
+    absolute floor) and a HOSTILE MIX (the same crowd plus over-askers,
+    pinning the light tier's p99 while the flood is demoted and shed).
+    Percentile keys are k-stamped with the SERVED square size, so
+    rounds at different block shapes never cross-compare.  Wall-clock
+    concurrency makes shed counts load-dependent — the recorded figures
+    are tails and rates, never exact schedules.  A leg that hits its
+    hard deadline reports {"error": ...} instead of partial numbers."""
+    from celestia_tpu.client.signer import Signer
+    from celestia_tpu.client.swarm import SwarmConfig, run_swarm
+    from celestia_tpu.da import das as das_mod
+    from celestia_tpu.da.blob import Blob
+    from celestia_tpu.da.namespace import Namespace
+    from celestia_tpu.node.server import NodeServer
+    from celestia_tpu.node.testnode import TestNode
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+
+    key = PrivateKey.from_seed(b"bench-swarm")
+    node = TestNode(funded_accounts=[(key, 10**12)])
+    signer = Signer(node, key)
+    rng = np.random.default_rng(23)
+    heights = []
+    for i in range(2):
+        data = bytes(rng.integers(0, 256, 4000, dtype=np.uint8))
+        res = signer.submit_pay_for_blob(
+            [Blob(Namespace.v0(bytes([0x41 + i]) * 10), data)]
+        )
+        if res.code != 0:
+            return {"error": f"blob submit failed: {res.log[:120]}"}
+        heights.append(res.height)
+    blocks = [(h, node.block(h).header.square_size) for h in heights]
+    k = max(s for _, s in blocks)
+
+    das_mod.rows_cache().clear()
+    server = NodeServer(
+        node,
+        block_interval_s=None,
+        das_max_inflight=4,
+        das_qos=True,
+        timeseries_interval_s=None,
+    )
+    server.start()
+    try:
+        honest = run_swarm(server.address, blocks, SwarmConfig(
+            clients=24, hostile=0, rounds=2, samples_per_round=1,
+            batch_sizes=(4, 8), seed=5, workers=8,
+            retry_attempts=4, request_deadline_s=5.0, deadline_s=30.0,
+        ))
+        mix = run_swarm(server.address, blocks, SwarmConfig(
+            clients=24, hostile=4, rounds=2, samples_per_round=1,
+            hostile_multiplier=8, batch_sizes=(4, 8), seed=6, workers=8,
+            retry_attempts=4, request_deadline_s=5.0, deadline_s=30.0,
+        ))
+    finally:
+        server.stop()
+
+    out = {"k": k, "clients": 24, "blocks": len(blocks)}
+    for name, rep, cfg_rounds in (
+        ("honest", honest, 2), ("hostile_mix", mix, 2),
+    ):
+        if rep["deadline_hit"] or rep["rounds_run"] < cfg_rounds:
+            out[name] = {
+                "error": f"deadline hit after {rep['rounds_run']} rounds"
+            }
+            continue
+        leg = {
+            "requests": rep["requests"],
+            "samples_per_s": rep["samples_per_s"],
+            f"light_p50_k{k}_ms": rep["latency"]["light"]["p50_ms"],
+            f"light_p99_k{k}_ms": rep["latency"]["light"]["p99_ms"],
+            "light_shed_rate": rep["groups"]["light"]["shed_rate"],
+        }
+        if rep["hostile"]:
+            leg[f"hostile_p99_k{k}_ms"] = (
+                rep["latency"]["hostile"]["p99_ms"]
+            )
+            leg["hostile_shed_rate"] = (
+                rep["groups"]["hostile"]["shed_rate"]
+            )
+        out[name] = leg
+    # the floor-judged contract figure is the HONEST crowd's fairness:
+    # with no over-askers a QoS-healthy plane serves near-uniformly
+    if isinstance(out.get("honest"), dict) and "error" not in out["honest"]:
+        out["fairness_index"] = honest["fairness_index"]
+    return out
+
+
 def _host_repair_ms(k: int):
     """Host-only repair (the light-client/DAS path — no accelerator):
     25% withheld, root-verified.  Under the leopard codec this runs the
@@ -1415,6 +1506,12 @@ def _host_only_main():
         extras["das_serving"] = _das_serving_extras(K)
     except Exception as e:
         extras["das_serving_error"] = repr(e)[:200]
+    try:
+        # light-client swarm legs: honest crowd + hostile mix against a
+        # live QoS-enabled node (per-tier tails, fairness vs 0.8 floor)
+        extras["swarm"] = _swarm_extras()
+    except Exception as e:
+        extras["swarm_error"] = repr(e)[:200]
     try:
         # device-resident plane ledger on the XLA CPU backend at a tiny
         # k (forced on — the CPU-compile wall makes full k infeasible):
@@ -1608,6 +1705,12 @@ def main():
         extras["das_serving"] = _das_serving_extras(k)
     except Exception as e:
         extras["das_serving_error"] = repr(e)[:200]
+    try:
+        # light-client swarm legs: honest crowd + hostile mix against a
+        # live QoS-enabled node (per-tier tails, fairness vs 0.8 floor)
+        extras["swarm"] = _swarm_extras()
+    except Exception as e:
+        extras["swarm_error"] = repr(e)[:200]
     try:
         # device-resident plane ledger: per-leg H2D/D2H bytes + ms for
         # extend vs device-warm proof serving (bench_check watches the

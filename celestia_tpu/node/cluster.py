@@ -258,6 +258,9 @@ def _peer_health(client) -> dict:
     cache_misses: Dict[str, float] = {}
     rpc: Dict[str, dict] = {}
     alerts: Dict[str, bool] = {}
+    peer_served: Dict[str, int] = {}
+    peer_shed: Dict[str, int] = {}
+    lanes: Dict[str, dict] = {}
     node_info = ""
     for name, labels, value in samples:
         if name == "celestia_tpu_node_info":
@@ -268,6 +271,14 @@ def _peer_health(client) -> dict:
             cache_misses[labels.get("cache", "?")] = value
         elif name == "celestia_tpu_alert_firing":
             alerts[labels.get("rule", "?")] = bool(value)
+        elif name == "celestia_tpu_das_peer_served_total":
+            peer_served[labels.get("peer", "?")] = int(value)
+        elif name == "celestia_tpu_das_peer_shed_total":
+            peer_shed[labels.get("peer", "?")] = int(value)
+        elif name.startswith("celestia_tpu_das_lane_"):
+            lane = labels.get("lane", "?")
+            key = name[len("celestia_tpu_das_lane_"):].replace("_total", "")
+            lanes.setdefault(lane, {})[key] = int(value)
         elif name.startswith("celestia_tpu_rpc_"):
             m = re.match(
                 r"celestia_tpu_rpc_(client_)?(\w+?)_"
@@ -314,6 +325,20 @@ def _peer_health(client) -> dict:
         "rows_hit_rate": float(
             by_name.get("celestia_tpu_das_rows_hit_rate", 0.0)
         ),
+        # per-peer QoS accounting (bounded labels — the serving node's
+        # LRU-backed registry caps cardinality): identified clients'
+        # served/shed counts, per-lane gate pressure, and this node's
+        # own fairness index (None until a peer has been served —
+        # skip-absent survives the scrape)
+        "clients": len(peer_served),
+        "peer_served": peer_served,
+        "peer_shed": peer_shed,
+        "lanes": lanes,
+        "fairness_index": (
+            float(by_name["celestia_tpu_das_fairness_index"])
+            if "celestia_tpu_das_fairness_index" in by_name
+            else None
+        ),
     }
     return {
         "node_id": node_info
@@ -357,6 +382,39 @@ def _peer_health(client) -> dict:
             by_name.get("celestia_tpu_flight_incidents_total", 0)
         ),
     }
+
+
+def _aggregate_clients(healthy: List[dict]) -> Dict[str, Dict[str, int]]:
+    """Per-CLIENT served/shed summed across every serving node (one
+    light client may sample from many nodes — fairness is judged on
+    what the mesh as a whole gave it)."""
+    agg: Dict[str, Dict[str, int]] = {}
+    for p in healthy:
+        das = p.get("das", {})
+        for cid, served in das.get("peer_served", {}).items():
+            agg.setdefault(cid, {"served": 0, "shed": 0})["served"] += served
+        for cid, shed in das.get("peer_shed", {}).items():
+            agg.setdefault(cid, {"served": 0, "shed": 0})["shed"] += shed
+    return agg
+
+
+def _mesh_fairness(healthy: List[dict]):
+    from celestia_tpu.utils.telemetry import jain_fairness_index
+
+    agg = _aggregate_clients(healthy)
+    return jain_fairness_index(st["served"] for st in agg.values())
+
+
+def _top_over_askers(healthy: List[dict], k: int = 5) -> List[dict]:
+    agg = _aggregate_clients(healthy)
+    ranked = sorted(
+        agg.items(),
+        key=lambda it: (-(it[1]["served"] + it[1]["shed"]), it[0]),
+    )
+    return [
+        {"peer": cid, "served": st["served"], "shed": st["shed"]}
+        for cid, st in ranked[:k]
+    ]
 
 
 def cluster_health(clients, probes: int = 3) -> dict:
@@ -414,6 +472,12 @@ def cluster_health(clients, probes: int = 3) -> dict:
             for p in healthy
             if p.get("das", {}).get("shed", 0) > 0
         ),
+        # swarm fairness rollup: Jain index over per-CLIENT served
+        # counts aggregated across every serving node (None until any
+        # node reports identified peers), and the top over-askers NAMED
+        # — the clients to demote/pin first
+        "das_fairness_index": _mesh_fairness(healthy),
+        "das_top_over_askers": _top_over_askers(healthy),
         "fault_notes": sum(p["fault_notes"] for p in healthy),
         # mesh-wide degradation flags (PR 11): summed trace truncation
         # and every peer with at least one firing alert rule — the

@@ -396,7 +396,9 @@ class RemoteNode:
         )
         return list(out.get("peers", []))
 
-    def das_sample(self, height: int, row: int, col: int, *, policy=None):
+    def das_sample(
+        self, height: int, row: int, col: int, *, policy=None, peer=None
+    ):
         """One DAS cell + proof from the node's serving plane.
 
         A shed response (load shedding or an injected serving fault) is
@@ -404,7 +406,12 @@ class RemoteNode:
         ``retry_after_ms`` pushback; returns the sample dict
         ``{"proof": ..., "data_root": ...}``.  The final shed attempt
         raises :class:`faults.Overloaded` — the caller's signal that the
-        plane is saturated, not broken."""
+        plane is saturated, not broken.
+
+        ``peer`` (optional) stamps a client-asserted identity on the
+        envelope for the server's per-peer QoS accounting; omitted =
+        anonymous, and old servers ignore the field (version-tolerant
+        envelopes)."""
         from celestia_tpu.utils import faults
 
         if policy is None:
@@ -414,12 +421,12 @@ class RemoteNode:
             )
 
         def attempt():
+            payload = {"height": height, "row": row, "col": col}
+            if peer:
+                payload["peer"] = str(peer)
             out = self._call_json(
                 "DasSample",
-                self._attach_tc(
-                    {"height": height, "row": row, "col": col},
-                    height=height,
-                ),
+                self._attach_tc(payload, height=height),
             )
             if out.get("shed"):
                 raise faults.Overloaded(
@@ -433,7 +440,8 @@ class RemoteNode:
         return policy.run(attempt, retry_on=(faults.Overloaded,))
 
     def das_sample_batch(
-        self, height: int, coords, *, policy=None, chunk: int = 0
+        self, height: int, coords, *, policy=None, chunk: int = 0,
+        peer=None,
     ) -> dict:
         """n DAS cells + proofs in ONE streaming request (the
         DasSampleBatch RPC): the server proves row-grouped chunks and
@@ -445,7 +453,9 @@ class RemoteNode:
         the unified RetryPolicy — honest pushback costs re-requesting
         nothing.  Returns ``{"proofs": [...], "data_root": hex}`` with
         proofs in the requested coordinate order; the final shed attempt
-        raises :class:`faults.Overloaded`."""
+        raises :class:`faults.Overloaded`.  ``peer`` stamps the optional
+        client-asserted identity for per-peer QoS accounting (see
+        :meth:`das_sample`)."""
         from celestia_tpu.utils import faults
 
         if policy is None:
@@ -464,6 +474,8 @@ class RemoteNode:
             }
             if chunk:
                 payload["chunk"] = int(chunk)
+            if peer:
+                payload["peer"] = str(peer)
             stream = self._call_stream(
                 "DasSampleBatch",
                 json.dumps(

@@ -79,10 +79,153 @@ def _identity(b: bytes) -> bytes:
     return b
 
 
+class _PeerRegistry:
+    """Bounded per-peer DAS serving accounting (specs/da_serving.md
+    "QoS lanes & per-peer accounting").
+
+    Peer ids are CLIENT-ASSERTED (an optional ``"peer"`` envelope field
+    on DasSample/DasSampleBatch — old clients simply stay anonymous),
+    so the server bounds everything about them: ids are truncated to
+    ``MAX_PEER_ID`` chars, at most ``max_peers`` peers are tracked on a
+    :class:`~celestia_tpu.utils.lru.LruCache` (label cardinality on the
+    exposition is bounded by the same cap; an evicted peer's labels
+    disappear from the scrape), and per-peer distinct-row tracking
+    saturates at ``MAX_ROWS_TRACKED``.  Served/shed/bytes/rows feed the
+    per-peer exposition lines and the Jain fairness index."""
+
+    MAX_PEER_ID = 64
+    MAX_ROWS_TRACKED = 512
+
+    def __init__(self, max_peers: int = 256):
+        from celestia_tpu.utils.lru import LruCache
+
+        self._lock = threading.Lock()
+        # entries are mutable dicts mutated only under self._lock
+        self._peers = LruCache("das_peers", max_entries=max(1, int(max_peers)))
+
+    @classmethod
+    def peer_id(cls, q) -> str:
+        """The bounded peer id out of a request envelope ('' = anonymous)."""
+        try:
+            raw = q.get("peer", "")
+        except Exception:
+            return ""
+        return str(raw or "")[: cls.MAX_PEER_ID]
+
+    def _entry(self, peer: str) -> dict:
+        # caller holds self._lock
+        st = self._peers.get(peer, count=False)
+        if st is None:
+            st = {
+                "served": 0, "shed": 0, "bytes": 0,
+                "rows": set(), "rows_capped": False, "lane": "",
+            }
+            self._peers.put(peer, st)
+        return st
+
+    def record_served(self, peer, cells, bytes_out, rows=(), lane=None):
+        if not peer:
+            return
+        with self._lock:
+            st = self._entry(peer)
+            st["served"] += int(cells)
+            st["bytes"] += int(bytes_out)
+            if lane:
+                st["lane"] = str(lane)
+            seen = st["rows"]
+            for key in rows:
+                if len(seen) >= self.MAX_ROWS_TRACKED:
+                    st["rows_capped"] = True
+                    break
+                seen.add(key)
+
+    def record_shed(self, peer, lane=None):
+        if not peer:
+            return
+        with self._lock:
+            st = self._entry(peer)
+            st["shed"] += 1
+            if lane:
+                st["lane"] = str(lane)
+
+    def snapshot(self) -> dict:
+        """peer -> flat counters (no mutable internals escape the lock)."""
+        with self._lock:
+            out = {}
+            for peer in self._peers.keys():
+                st = self._peers.peek(peer)
+                if st is None:  # raced an eviction
+                    continue
+                out[peer] = {
+                    "served": st["served"],
+                    "shed": st["shed"],
+                    "bytes": st["bytes"],
+                    "rows": len(st["rows"]),
+                    "lane": st["lane"],
+                }
+            return out
+
+    def fairness_index(self) -> Optional[float]:
+        """Jain fairness over per-peer SERVED counts; None until at
+        least one identified peer has been served (skip-absent: the
+        metric must not exist before there is a distribution to judge)."""
+        from celestia_tpu.utils.telemetry import jain_fairness_index
+
+        with self._lock:
+            served = []
+            for peer in self._peers.keys():
+                st = self._peers.peek(peer)
+                if st is not None:
+                    served.append(st["served"])
+        return jain_fairness_index(served)
+
+    def exposition_lines(self) -> list:
+        """Bounded-label per-peer exposition (cardinality capped by the
+        registry's LRU bound, values escaped — always parse-valid)."""
+        from celestia_tpu.utils.telemetry import escape_label_value
+
+        snap = self.snapshot()
+        if not snap:
+            return []
+        lines = [
+            "# TYPE celestia_tpu_das_peer_served_total counter",
+            "# TYPE celestia_tpu_das_peer_shed_total counter",
+            "# TYPE celestia_tpu_das_peer_bytes_total counter",
+        ]
+        for peer in sorted(snap):
+            st = snap[peer]
+            lbl = escape_label_value(peer)
+            lines.append(
+                f'celestia_tpu_das_peer_served_total{{peer="{lbl}"}} '
+                f'{st["served"]}'
+            )
+            lines.append(
+                f'celestia_tpu_das_peer_shed_total{{peer="{lbl}"}} '
+                f'{st["shed"]}'
+            )
+            lines.append(
+                f'celestia_tpu_das_peer_bytes_total{{peer="{lbl}"}} '
+                f'{st["bytes"]}'
+            )
+            lines.append(
+                f'celestia_tpu_das_peer_rows{{peer="{lbl}"}} {st["rows"]}'
+            )
+            if st["lane"]:
+                lane = escape_label_value(st["lane"])
+                lines.append(
+                    f'celestia_tpu_das_peer_lane{{peer="{lbl}",'
+                    f'lane="{lane}"}} 1'
+                )
+        return lines
+
+
 class NodeService:
     """Method implementations over an in-process node (TestNode surface)."""
 
-    def __init__(self, node, das_max_inflight: int = 4, flight=None):
+    def __init__(
+        self, node, das_max_inflight: int = 4, flight=None,
+        das_qos: bool = False,
+    ):
         from celestia_tpu.utils import timeseries as ts_mod
         from celestia_tpu.utils.telemetry import clock
 
@@ -107,9 +250,66 @@ class NodeService:
         # max_workers, default 8): with bound == workers no request can
         # ever observe a full gate and shedding silently never happens,
         # while consensus RPCs starve behind queued samples.
-        self.das_gate = faults.LoadShedGate(
-            max_inflight=das_max_inflight, retry_after_ms=25.0
-        )
+        # QoS lanes (opt-in, das_qos=True): the same gate capacity split
+        # into a reserved `light` lane plus a shared pool `bulk` and
+        # `hostile` compete for, with deterministic recent-usage tier
+        # assignment — a flood of over-askers saturates the shared pool
+        # but can never starve reserved light-lane admissions.  Off by
+        # default: the degenerate single-lane gate is byte-for-byte the
+        # pre-QoS weighted gate.
+        if das_qos:
+            reserved_light = max(1, int(das_max_inflight) // 2)
+            self.das_gate = faults.LoadShedGate(
+                max_inflight=das_max_inflight,
+                retry_after_ms=25.0,
+                lanes=(
+                    (faults.TierPolicy.LIGHT, reserved_light),
+                    (faults.TierPolicy.BULK, 0),
+                    (faults.TierPolicy.HOSTILE, 0),
+                ),
+            )
+            self.das_tiers: Optional[faults.TierPolicy] = faults.TierPolicy()
+        else:
+            self.das_gate = faults.LoadShedGate(
+                max_inflight=das_max_inflight, retry_after_ms=25.0
+            )
+            self.das_tiers = None
+        # per-peer serving accounting + per-tier end-to-end latency
+        self.das_peers = _PeerRegistry()
+        self._das_lat_lock = threading.Lock()
+        self._das_lat: dict = {}  # lane -> Log2Histogram
+        # backref for collect_node_sample (utils/timeseries.py): the
+        # gate/fairness signals live on the service, the collector gets
+        # the node
+        node._das_service = self
+
+    def _das_lane(self, peer: str, rows: int) -> Optional[str]:
+        """Tier-assign one request: note the asked rows (demotion must
+        see offered load, served or shed) and return the current lane
+        (None when QoS lanes are off — the degenerate gate)."""
+        if self.das_tiers is None:
+            return None
+        if peer:
+            self.das_tiers.note(peer, rows=rows)
+        return self.das_tiers.lane_for(peer)
+
+    def _observe_das_latency(self, lane: Optional[str], t0: float) -> None:
+        from celestia_tpu.utils.telemetry import Log2Histogram, clock
+
+        name = lane or faults.TierPolicy.LIGHT
+        with self._das_lat_lock:
+            hist = self._das_lat.get(name)
+            if hist is None:
+                hist = Log2Histogram()
+                self._das_lat[name] = hist
+        hist.observe(max(0.0, clock() - t0))
+
+    def das_latency_summary(self) -> dict:
+        """Per-tier end-to-end sample latency summary (lane ->
+        count/p50/p99/... in ms)."""
+        with self._das_lat_lock:
+            items = sorted(self._das_lat.items())
+        return {lane: hist.summary() for lane, hist in items}
 
     # -- handlers (bytes -> bytes) ------------------------------------
 
@@ -289,18 +489,33 @@ class NodeService:
         of hammering a saturated node; the ``server.sample`` fault point
         makes the handler itself injectable for the chaos suite (an
         injected failure is reported as retriable, exactly like shed
-        load — the client cannot tell a chaos drill from real pressure)."""
-        if not self.das_gate.try_acquire():
-            self.node.app.telemetry.incr("das_sample_shed")
-            tracing.instant("das_sample.shed", cat="serving")
-            return json.dumps(
-                {
-                    "shed": True,
-                    "retry_after_ms": self.das_gate.retry_after_ms,
-                }
-            ).encode()
+        load — the client cannot tell a chaos drill from real pressure).
+
+        The optional client-asserted ``"peer"`` envelope field feeds the
+        bounded per-peer accounting + the QoS tier hook; requests
+        without it stay anonymous on the pre-QoS path (version-tolerant
+        envelopes — old clients need no change)."""
+        from celestia_tpu.utils.telemetry import clock
+
+        t0 = clock()
         try:
             q = json.loads(req or b"{}")
+        except Exception as e:
+            return json.dumps({"code": 1, "log": str(e)}).encode()
+        peer = _PeerRegistry.peer_id(q)
+        lane = self._das_lane(peer, rows=1)
+        if not self.das_gate.try_acquire(lane=lane):
+            self.node.app.telemetry.incr("das_sample_shed")
+            self.das_peers.record_shed(peer, lane)
+            tracing.instant("das_sample.shed", cat="serving")
+            shed = {
+                "shed": True,
+                "retry_after_ms": self.das_gate.retry_after_ms,
+            }
+            if lane is not None:
+                shed["lane"] = lane
+            return json.dumps(shed).encode()
+        try:
             with tracing.rpc_span(
                 "das_sample", q.get("_tc"), cat="serving",
                 height=int(q.get("height", 0) or 0),
@@ -310,7 +525,15 @@ class NodeService:
                 faults.fire("server.sample")
                 out = self.node.abci_query("custom/das/sample", q)
             self.node.app.telemetry.incr("das_samples_served")
-            return json.dumps({"shed": False, **out}, default=str).encode()
+            resp = json.dumps({"shed": False, **out}, default=str).encode()
+            self.das_peers.record_served(
+                peer, cells=1, bytes_out=len(resp),
+                rows=((int(q.get("height", 0) or 0),
+                       int(q.get("row", 0) or 0)),),
+                lane=lane,
+            )
+            self._observe_das_latency(lane, t0)
+            return resp
         except faults.InjectedFault as e:
             return json.dumps(
                 {
@@ -322,7 +545,7 @@ class NodeService:
         except Exception as e:
             return json.dumps({"code": 1, "log": str(e)}).encode()
         finally:
-            self.das_gate.release()
+            self.das_gate.release(lane=lane)
 
     # DasSampleBatch chunking: cells proven (and streamed) per response
     # message.  Bounds BOTH the per-message JSON size (a 10k-cell
@@ -349,9 +572,18 @@ class NodeService:
         cells.  The ``server.sample`` fault point makes every chunk
         injectable for the chaos suite, reported as retriable exactly
         like shed load."""
+        from celestia_tpu.utils.telemetry import clock
+
         q = json.loads(req or b"{}")
         coords = [(int(r), int(c)) for r, c in q.get("coords", [])]
         height = int(q.get("height", 0) or 0)
+        peer = _PeerRegistry.peer_id(q)
+        # tier usage counts the batch's ASKED cells up front: a shed
+        # over-asker keeps offering load, and demotion must see it (cells
+        # not distinct rows — a tiny square caps rows at 2k, which would
+        # let an over-asker hide arbitrary cell volume behind few rows)
+        if self.das_tiers is not None and peer:
+            self.das_tiers.note(peer, rows=len(coords))
         chunk = max(
             1, min(int(q.get("chunk", 0) or self.DAS_BATCH_CHUNK),
                    self.DAS_BATCH_CHUNK)
@@ -387,16 +619,27 @@ class NodeService:
         ):
             for i, part in enumerate(chunks):
                 weight = len({r for r, _ in part})
-                if not self.das_gate.try_acquire(weight=weight):
+                # lane re-evaluated per chunk: an over-asker's giant
+                # batch slides to bulk/hostile MID-STREAM once the usage
+                # window catches up — demotion is not per-connection
+                lane = (
+                    self.das_tiers.lane_for(peer)
+                    if self.das_tiers is not None
+                    else None
+                )
+                t0 = clock()
+                if not self.das_gate.try_acquire(weight=weight, lane=lane):
                     telemetry.incr("das_batch_shed")
+                    self.das_peers.record_shed(peer, lane)
                     tracing.instant("das_sample_batch.shed", cat="serving")
-                    yield json.dumps(
-                        {
-                            "shed": True,
-                            "retry_after_ms": self.das_gate.retry_after_ms,
-                            "served": served,
-                        }
-                    ).encode()
+                    shed = {
+                        "shed": True,
+                        "retry_after_ms": self.das_gate.retry_after_ms,
+                        "served": served,
+                    }
+                    if lane is not None:
+                        shed["lane"] = lane
+                    yield json.dumps(shed).encode()
                     return
                 try:
                     faults.fire("server.sample")
@@ -406,7 +649,7 @@ class NodeService:
                     )
                     telemetry.incr("das_samples_served", len(part))
                     served += len(part)
-                    yield json.dumps(
+                    resp = json.dumps(
                         {
                             "shed": False,
                             "done": i == len(chunks) - 1,
@@ -414,6 +657,13 @@ class NodeService:
                         },
                         default=str,
                     ).encode()
+                    self.das_peers.record_served(
+                        peer, cells=len(part), bytes_out=len(resp),
+                        rows=[(height, r) for r, _ in part],
+                        lane=lane,
+                    )
+                    self._observe_das_latency(lane, t0)
+                    yield resp
                 except faults.InjectedFault as e:
                     # reported retriable like shed load, but NOT counted
                     # as shed: the shed counters track real gate
@@ -433,7 +683,7 @@ class NodeService:
                     yield json.dumps({"code": 1, "log": str(e)}).encode()
                     return
                 finally:
-                    self.das_gate.release(weight=weight)
+                    self.das_gate.release(weight=weight, lane=lane)
 
     # -- observability plane (utils/telemetry.py + utils/tracing.py) ----
 
@@ -536,6 +786,55 @@ class NodeService:
         )
         lines.append("# TYPE celestia_tpu_das_gate_shed_total counter")
         lines.append(f"celestia_tpu_das_gate_shed_total {gate['shed']}")
+        # QoS lanes (when configured): per-lane reserved/inflight plus
+        # admitted/shed counters — the fairness story per tier
+        lane_table = gate.get("lanes")
+        if lane_table:
+            lines.append(
+                "# TYPE celestia_tpu_das_lane_admitted_total counter"
+            )
+            lines.append("# TYPE celestia_tpu_das_lane_shed_total counter")
+            for lane_name in sorted(lane_table):
+                lst = lane_table[lane_name]
+                ll = escape_label_value(lane_name)
+                lines.append(
+                    f'celestia_tpu_das_lane_reserved{{lane="{ll}"}} '
+                    f'{lst["reserved"]}'
+                )
+                lines.append(
+                    f'celestia_tpu_das_lane_inflight{{lane="{ll}"}} '
+                    f'{lst["inflight"]}'
+                )
+                lines.append(
+                    f'celestia_tpu_das_lane_admitted_total{{lane="{ll}"}} '
+                    f'{lst["admitted"]}'
+                )
+                lines.append(
+                    f'celestia_tpu_das_lane_shed_total{{lane="{ll}"}} '
+                    f'{lst["shed"]}'
+                )
+        # per-tier end-to-end sample latency (lane folded into the
+        # metric name: lane names are server-defined, so the family set
+        # is bounded; Log2Histogram renders proper cumulative buckets)
+        from celestia_tpu.utils.telemetry import sanitize_metric_name
+
+        with self._das_lat_lock:
+            lat_items = sorted(self._das_lat.items())
+        for lane_name, hist in lat_items:
+            lines.extend(
+                hist.prometheus_lines(
+                    "celestia_tpu_das_latency_"
+                    f"{sanitize_metric_name(lane_name)}_seconds"
+                )
+            )
+        # per-peer accounting (bounded labels — see _PeerRegistry) + the
+        # Jain fairness index (skip-absent until a peer has been served)
+        lines.extend(self.das_peers.exposition_lines())
+        fairness = self.das_peers.fairness_index()
+        if fairness is not None:
+            lines.append(
+                f"celestia_tpu_das_fairness_index {round(fairness, 6)}"
+            )
         rows = das_mod.rows_cache().stats()
         lines.append(
             f"celestia_tpu_das_rows_hit_rate {round(rows['hit_rate'], 6)}"
@@ -784,6 +1083,22 @@ class NodeService:
         firing = [
             a["name"] for a in self.alert_engine.firing(self.timeseries)
         ]
+        # DAS serving health without a metrics scrape: gate shed totals,
+        # per-lane inflight, and the current fairness index (omitted
+        # until an identified peer has been served — skip-absent)
+        gate = self.das_gate.stats()
+        das = {
+            "gate_shed": gate["shed"],
+            "gate_admitted": gate["admitted"],
+            "lanes": (
+                {n: st["inflight"] for n, st in gate["lanes"].items()}
+                if "lanes" in gate
+                else {"default": gate["inflight"]}
+            ),
+        }
+        fairness = self.das_peers.fairness_index()
+        if fairness is not None:
+            das["fairness_index"] = round(fairness, 4)
         return {
             "status": "degraded" if firing else "ok",
             "node_id": tracing.node_id(),
@@ -799,6 +1114,7 @@ class NodeService:
                 if self.flight is not None
                 else 0
             ),
+            "das": das,
         }
 
     def query(self, req: bytes, ctx) -> bytes:
@@ -1073,6 +1389,7 @@ class NodeServer:
         block_interval_s: Optional[float] = None,
         max_workers: int = 8,
         das_max_inflight: int = 4,
+        das_qos: bool = False,
         metrics_port: Optional[int] = None,
         timeseries_interval_s: Optional[float] = 5.0,
         host_profile_hz: Optional[float] = None,
@@ -1086,7 +1403,8 @@ class NodeServer:
 
             flight = FlightRecorder(flight_dir)
         self.service = NodeService(
-            node, das_max_inflight=das_max_inflight, flight=flight
+            node, das_max_inflight=das_max_inflight, flight=flight,
+            das_qos=das_qos,
         )
         # host sampling profiler: started/stopped with the server when a
         # rate is given (the module may also be armed via env — in that

@@ -688,39 +688,263 @@ class LoadShedGate:
     batching cannot launder n requests' load past a gate sized for
     single-cell traffic.  An oversize weight (> ``max_inflight``) is
     admitted only when the gate is fully idle — bounded overshoot beats
-    a request class that can never be served."""
+    a request class that can never be served.
 
-    def __init__(self, max_inflight: int = 8, retry_after_ms: float = 25.0):
+    QoS LANES (opt-in): pass ``lanes`` as a sequence of
+    ``(name, reserved)`` pairs (or a mapping name -> reserved) to split
+    ``max_inflight`` into per-lane reserved capacity plus one shared
+    pool (``max_inflight - sum(reserved)``).  A lane's inflight up to
+    its reservation never touches the shared pool, so a flood on a
+    zero-reserved lane (``bulk``/``hostile``) can saturate only the
+    shared pool and can never starve a reserved lane's admissions.
+    Per-lane admitted/shed/inflight are tracked alongside the global
+    counters.  With ``lanes=None`` (the default) the gate runs the
+    original single-lane code path unchanged — the weighted single-gate
+    behavior IS the degenerate one-lane case."""
+
+    def __init__(
+        self,
+        max_inflight: int = 8,
+        retry_after_ms: float = 25.0,
+        lanes: Optional[Any] = None,
+    ):
         self.max_inflight = max(1, int(max_inflight))
         self.retry_after_ms = float(retry_after_ms)
         self._lock = threading.Lock()
         self._inflight = 0  # celint: guarded-by(self._lock)
         self.admitted = 0  # celint: guarded-by(self._lock)
         self.shed = 0  # celint: guarded-by(self._lock)
+        self._lanes: Optional[Dict[str, Dict[str, int]]] = None
+        self._shared_capacity = 0
+        self._shared_used = 0  # celint: guarded-by(self._lock)
+        self._default_lane: Optional[str] = None
+        if lanes is not None:
+            pairs = list(lanes.items()) if hasattr(lanes, "items") else list(lanes)
+            if not pairs:
+                raise ValueError("lanes must name at least one lane")
+            table: Dict[str, Dict[str, int]] = {}
+            for name, reserved in pairs:
+                name = str(name)
+                if name in table:
+                    raise ValueError(f"duplicate lane {name!r}")
+                # inflight/admitted/shed counters are mutated only
+                # under self._lock (same discipline as the gate totals)
+                table[name] = {
+                    "reserved": max(0, int(reserved)),
+                    "inflight": 0,
+                    "admitted": 0,
+                    "shed": 0,
+                }
+            total_reserved = sum(st["reserved"] for st in table.values())
+            if total_reserved > self.max_inflight:
+                raise ValueError(
+                    f"reserved capacity {total_reserved} exceeds "
+                    f"max_inflight {self.max_inflight}"
+                )
+            self._lanes = table
+            self._shared_capacity = self.max_inflight - total_reserved
+            self._default_lane = next(iter(table))
 
-    def try_acquire(self, weight: int = 1) -> bool:
+    def _lane_state(self, lane: Optional[str]) -> Dict[str, int]:
+        # caller holds self._lock; unknown lane names fall back to the
+        # first-declared lane so a stale client label cannot crash serving
+        assert self._lanes is not None
+        st = self._lanes.get(lane) if lane is not None else None
+        if st is None:
+            st = self._lanes[self._default_lane]
+        return st
+
+    def try_acquire(self, weight: int = 1, lane: Optional[str] = None) -> bool:
         weight = max(1, int(weight))
         with self._lock:
-            if self._inflight > 0 and (
-                self._inflight + weight > self.max_inflight
-            ):
+            if self._lanes is None:
+                if self._inflight > 0 and (
+                    self._inflight + weight > self.max_inflight
+                ):
+                    self.shed += 1
+                    return False
+                self._inflight += weight
+                self.admitted += 1
+                return True
+            st = self._lane_state(lane)
+            cur = st["inflight"]
+            old_excess = max(0, cur - st["reserved"])
+            new_excess = max(0, cur + weight - st["reserved"])
+            over_shared = (
+                self._shared_used - old_excess + new_excess
+                > self._shared_capacity
+            )
+            # global-idle oversize admission is preserved lane-wise: a
+            # weight larger than the whole gate is admitted only when
+            # NOTHING is inflight anywhere (bounded overshoot, as above)
+            if self._inflight > 0 and over_shared:
                 self.shed += 1
+                st["shed"] += 1
                 return False
+            st["inflight"] = cur + weight
+            st["admitted"] += 1
+            self._shared_used += new_excess - old_excess
             self._inflight += weight
             self.admitted += 1
             return True
 
-    def release(self, weight: int = 1) -> None:
+    def release(self, weight: int = 1, lane: Optional[str] = None) -> None:
+        weight = max(1, int(weight))
         with self._lock:
-            self._inflight = max(0, self._inflight - max(1, int(weight)))
+            if self._lanes is None:
+                self._inflight = max(0, self._inflight - weight)
+                return
+            st = self._lane_state(lane)
+            cur = st["inflight"]
+            take = min(cur, weight)
+            old_excess = max(0, cur - st["reserved"])
+            new_excess = max(0, cur - take - st["reserved"])
+            st["inflight"] = cur - take
+            self._shared_used = max(
+                0, self._shared_used - (old_excess - new_excess)
+            )
+            self._inflight = max(0, self._inflight - take)
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "max_inflight": self.max_inflight,
                 "inflight": self._inflight,
                 "admitted": self.admitted,
                 "shed": self.shed,
+            }
+            if self._lanes is not None:
+                out["shared_capacity"] = self._shared_capacity
+                out["shared_inflight"] = self._shared_used
+                out["lanes"] = {
+                    name: dict(st) for name, st in self._lanes.items()
+                }
+            return out
+
+
+# ---------------------------------------------------------------------------
+# QoS tier assignment (deterministic peer -> lane policy)
+# ---------------------------------------------------------------------------
+
+
+class TierPolicy:
+    """Deterministic peer -> QoS lane assignment for a laned
+    :class:`LoadShedGate`.
+
+    Default policy is RECENT-USAGE DEMOTION: each peer's asked rows are
+    counted in a two-bucket sliding window (current + previous epoch of
+    ``window_s`` seconds, rotated on an injectable clock, so the signal
+    is deterministic under a virtual clock and needs no timers).  A peer
+    whose recent asked-rows reach ``demote_rows`` slides from ``light``
+    to ``bulk``; reaching ``hostile_rows`` auto-pins it to ``hostile``
+    for ``pin_cooldown_s`` (and :meth:`pin` applies the same
+    :meth:`CircuitBreaker.trip`-style pinning manually).  Per-peer state
+    lives on a bounded :class:`~celestia_tpu.utils.lru.LruCache`, so an
+    open swarm cannot grow server memory without bound — an evicted
+    peer simply restarts as ``light``.
+    """
+
+    LIGHT = "light"
+    BULK = "bulk"
+    HOSTILE = "hostile"
+    LANES = (LIGHT, BULK, HOSTILE)
+
+    def __init__(
+        self,
+        demote_rows: int = 64,
+        hostile_rows: int = 256,
+        window_s: float = 2.0,
+        pin_cooldown_s: float = 30.0,
+        max_peers: int = 1024,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        from celestia_tpu.utils.lru import LruCache
+
+        self.demote_rows = max(1, int(demote_rows))
+        self.hostile_rows = max(self.demote_rows, int(hostile_rows))
+        self.window_s = max(1e-6, float(window_s))
+        self.pin_cooldown_s = max(0.0, float(pin_cooldown_s))
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        # entries are mutable dicts mutated only under self._lock
+        self._usage = LruCache("qos_peer_usage", max_entries=max(1, int(max_peers)))
+        self.pins = 0  # celint: guarded-by(self._lock)
+
+    def _entry(self, peer: str) -> Dict[str, float]:
+        # caller holds self._lock
+        st = self._usage.get(peer, count=False)
+        if st is None:
+            st = {"epoch": -1, "cur": 0.0, "prev": 0.0, "pin_until": 0.0}
+            self._usage.put(peer, st)
+        return st
+
+    def _rotate(self, st: Dict[str, float], epoch: int) -> None:
+        # caller holds self._lock
+        if epoch == st["epoch"]:
+            return
+        if epoch == st["epoch"] + 1:
+            st["prev"] = st["cur"]
+        else:
+            st["prev"] = 0.0
+        st["cur"] = 0.0
+        st["epoch"] = epoch
+
+    def note(self, peer: str, rows: int = 1) -> None:
+        """Record ``rows`` of asked work for ``peer`` (asked, not served
+        — demotion must see the load a shed over-asker keeps offering)."""
+        if not peer:
+            return
+        with self._lock:
+            now = self._clock()
+            st = self._entry(peer)
+            self._rotate(st, int(now / self.window_s))
+            st["cur"] += max(0, int(rows))
+            if (
+                st["cur"] + st["prev"] >= self.hostile_rows
+                and now >= st["pin_until"]
+            ):
+                st["pin_until"] = now + self.pin_cooldown_s
+                self.pins += 1
+
+    def pin(self, peer: str, cooldown_s: Optional[float] = None) -> None:
+        """Pin ``peer`` to the hostile lane for ``cooldown_s`` (default
+        ``pin_cooldown_s``) — the trip()-style manual override."""
+        if not peer:
+            return
+        with self._lock:
+            st = self._entry(peer)
+            hold = self.pin_cooldown_s if cooldown_s is None else float(cooldown_s)
+            st["pin_until"] = self._clock() + max(0.0, hold)
+            self.pins += 1
+
+    def lane_for(self, peer: str) -> str:
+        """Deterministic lane for ``peer`` right now.  Unknown / empty
+        peers are ``light`` — anonymity costs nothing until usage does."""
+        if not peer:
+            return self.LIGHT
+        with self._lock:
+            st = self._usage.get(peer, count=False)
+            if st is None:
+                return self.LIGHT
+            now = self._clock()
+            if now < st["pin_until"]:
+                return self.HOSTILE
+            self._rotate(st, int(now / self.window_s))
+            recent = st["cur"] + st["prev"]
+            if recent >= self.hostile_rows:
+                return self.HOSTILE
+            if recent >= self.demote_rows:
+                return self.BULK
+            return self.LIGHT
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "peers": self._usage.stats()["entries"],
+                "pins": self.pins,
+                "demote_rows": self.demote_rows,
+                "hostile_rows": self.hostile_rows,
+                "window_s": self.window_s,
             }
 
 

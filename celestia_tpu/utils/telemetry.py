@@ -25,7 +25,7 @@ import re
 import threading
 import time
 from collections import defaultdict
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 
 def clock() -> float:
@@ -201,6 +201,23 @@ def escape_label_value(value: str) -> str:
         .replace('"', '\\"')
         .replace("\n", "\\n")
     )
+
+
+def jain_fairness_index(values) -> Optional[float]:
+    """Jain's fairness index over per-peer allocation counts:
+    ``(sum x)^2 / (n * sum x^2)``.  1.0 = perfectly even, 1/n = one peer
+    took everything.  Zero-allocation peers COUNT (a starved peer is the
+    unfairness being measured); returns ``None`` when there is no signal
+    at all (no peers, or nothing served yet) so callers can honor the
+    skip-absent contract instead of reporting a fake 0."""
+    xs = [max(0.0, float(v)) for v in values]
+    if not xs:
+        return None
+    sq = sum(x * x for x in xs)
+    if sq <= 0.0:
+        return None
+    total = sum(xs)
+    return (total * total) / (len(xs) * sq)
 
 
 class Telemetry:

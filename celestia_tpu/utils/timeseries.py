@@ -59,6 +59,13 @@ BREAKERS_OPEN_FOR_S = 30.0
 DEVICE_MEM_FRAC_CEIL = 0.9
 DEVICE_MEM_FOR_S = 30.0
 HEIGHT_STALL_FOR_S = 60.0
+# Jain fairness over per-peer served DAS samples: below this the crowd
+# is being served unfairly (hostile over-askers crowding light clients).
+# for_s=0 — fairness is computed over cumulative counts, so one bad
+# sample already summarizes sustained skew; the metric is skip-absent
+# (only exists once an identified peer has been served), so anonymous
+# traffic can never fire it.
+DAS_FAIRNESS_FLOOR = 0.8
 
 
 class TimeSeries:
@@ -329,6 +336,18 @@ def default_rules() -> List[AlertRule]:
             for_s=0.0,
             severity="warning",
         ),
+        AlertRule(
+            # swarm fairness collapse (hostile over-askers starving the
+            # light tier): trips the flight recorder into an incident
+            # bundle — see specs/da_serving.md "QoS lanes & per-peer
+            # accounting" for the fairness definition
+            "das_fairness_floor",
+            metric="das_fairness_index",
+            op="<",
+            threshold=DAS_FAIRNESS_FLOOR,
+            for_s=0.0,
+            severity="warning",
+        ),
     ]
 
 
@@ -452,4 +471,20 @@ def collect_node_sample(node) -> Dict[str, float]:
     das_rows = reg["caches"].get("das_rows")
     if das_rows is not None and (das_rows["hits"] + das_rows["misses"]) > 0:
         values["das_rows_hit_rate"] = float(das_rows["hit_rate"])
+    # per-peer QoS plane (node/server.py NodeService backref): gate +
+    # per-lane pressure and the Jain fairness index.  Fairness is
+    # skip-absent — it only exists once an identified peer has been
+    # served, so the stock das_fairness_floor rule self-disables on
+    # nodes serving purely anonymous traffic
+    svc = getattr(node, "_das_service", None)
+    if svc is not None:
+        gate = svc.das_gate.stats()
+        values["das_gate_inflight"] = float(gate["inflight"])
+        values["das_gate_shed"] = float(gate["shed"])
+        for lane, lst in (gate.get("lanes") or {}).items():
+            values[f"das_lane_inflight_{lane}"] = float(lst["inflight"])
+            values[f"das_lane_shed_{lane}"] = float(lst["shed"])
+        fairness = svc.das_peers.fairness_index()
+        if fairness is not None:
+            values["das_fairness_index"] = float(fairness)
     return values

@@ -196,6 +196,58 @@ def test_host_profile_overhead_absolute_ceiling(tmp_path, capsys):
     assert bench_check.main(["--dir", str(tmp_path)]) == 1
 
 
+def test_swarm_fairness_absolute_floor_and_tier_p99(tmp_path, capsys):
+    """extras.swarm: the honest-crowd fairness index is judged against
+    the ABSOLUTE 0.8 floor on the latest round only (the mirror of the
+    sampler-overhead ceiling — a lucky 0.99 round must not fail every
+    later 0.95), and the k-stamped per-tier p99 figures regress
+    lower-is-better like any latency series."""
+    good = {"swarm": {
+        "k": 4, "fairness_index": 0.97,
+        "honest": {"light_p50_k4_ms": 3.0, "light_p99_k4_ms": 20.0,
+                   "samples_per_s": 4000.0},
+        "hostile_mix": {"light_p99_k4_ms": 30.0,
+                        "hostile_p99_k4_ms": 90.0},
+    }}
+    still_good = {"swarm": {
+        "k": 4, "fairness_index": 0.81,  # far below best 0.97, over floor
+        "honest": {"light_p50_k4_ms": 3.1, "light_p99_k4_ms": 21.0},
+        "hostile_mix": {"light_p99_k4_ms": 31.0},
+    }}
+    unfair = {"swarm": {
+        "k": 4, "fairness_index": 0.55,  # below the 0.8 floor
+        "honest": {"light_p99_k4_ms": 20.0},
+    }}
+    slow = {"swarm": {
+        "k": 4, "fairness_index": 0.97,
+        "honest": {"light_p99_k4_ms": 200.0},  # 10x the best p99
+    }}
+    # a big fairness DROP that stays over the floor passes (latest-only)
+    _write_rounds(tmp_path, [_round(1, extras=good),
+                             _round(2, extras=still_good)])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+    # under the floor fails loudly, names the metric and the direction
+    _write_rounds(tmp_path, [_round(1, extras=good),
+                             _round(2, extras=unfair)])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "swarm.fairness_index" in err
+    assert "floor" in err
+    # only the LATEST round is judged: an old under-floor round with a
+    # recovered latest passes
+    _write_rounds(tmp_path, [_round(1, extras=unfair),
+                             _round(2, extras=good)])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+    # the per-tier p99 series regresses like any latency headline
+    _write_rounds(tmp_path, [_round(1, extras=good),
+                             _round(2, extras=slow)])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "swarm.honest.light_p99_k4_ms" in err
+    # throughput/aux figures under the legs are recorded, not watched
+    assert "samples_per_s" not in err
+
+
 def test_check_series_semantics():
     rounds = [
         ("r1", {"m_ms": (10.0, False), "only_r1_ms": (5.0, False)}),

@@ -340,3 +340,201 @@ def test_load_shed_gate_weighted_admission():
     g.release(weight=9)
     assert g.try_acquire() and not g.try_acquire(weight=9)
     assert g.stats()["shed"] == 4
+
+
+# ---------------------------------------------------------------------------
+# QoS lanes (tiered LoadShedGate) + TierPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_one_lane_gate_is_byte_for_byte_the_weighted_gate():
+    """The degenerate one-lane config replays the EXACT weighted-gate
+    decision sequence (same admits, same sheds, same counters) and the
+    default no-lanes stats() dict stays the pinned 4-key shape."""
+    plain = faults.LoadShedGate(max_inflight=4, retry_after_ms=10.0)
+    laned = faults.LoadShedGate(
+        max_inflight=4, retry_after_ms=10.0, lanes=[("only", 0)]
+    )
+    script = [
+        ("acq", 3), ("acq", 2), ("acq", 1), ("acq", 1),
+        ("rel", 1), ("rel", 3), ("acq", 9), ("acq", 1),
+        ("rel", 9), ("acq", 1), ("acq", 9), ("rel", 1),
+    ]
+    for op, w in script:
+        if op == "acq":
+            assert plain.try_acquire(weight=w) == laned.try_acquire(weight=w)
+        else:
+            plain.release(weight=w)
+            laned.release(weight=w)
+    ps, ls = plain.stats(), laned.stats()
+    # the plain gate's stats ARE the four pinned keys, nothing else
+    assert set(ps) == {"max_inflight", "inflight", "admitted", "shed"}
+    assert {k: ls[k] for k in ps} == ps
+    only = ls["lanes"]["only"]
+    assert only["admitted"] == ps["admitted"]
+    assert only["shed"] == ps["shed"]
+    assert only["inflight"] == ps["inflight"]
+
+
+def test_lane_reservation_cannot_be_starved_by_bulk_flood():
+    """light's reserved capacity is invisible to bulk/hostile: a
+    saturating bulk flood caps at the shared pool, light admissions
+    within the reservation always succeed, and per-lane shed accounting
+    is exact (sums to the gate total)."""
+    g = faults.LoadShedGate(
+        max_inflight=8,
+        lanes=[("light", 4), ("bulk", 0), ("hostile", 0)],
+    )
+    # bulk floods: only the shared pool (8 - 4 reserved = 4) admits
+    admitted = 0
+    for _ in range(10):
+        if g.try_acquire(lane="bulk"):
+            admitted += 1
+    assert admitted == 4
+    # light still has its FULL reservation
+    for _ in range(4):
+        assert g.try_acquire(lane="light")
+    assert not g.try_acquire(lane="light")  # reservation spent, shared full
+    st = g.stats()
+    assert st["inflight"] == 8
+    assert st["shared_inflight"] == 4
+    assert st["lanes"]["bulk"]["shed"] == 6
+    assert st["lanes"]["light"]["shed"] == 1
+    assert st["shed"] == sum(x["shed"] for x in st["lanes"].values())
+    assert st["admitted"] == sum(
+        x["admitted"] for x in st["lanes"].values()
+    )
+    # full drain returns every lane and the shared pool to zero
+    for _ in range(4):
+        g.release(lane="bulk")
+    for _ in range(4):
+        g.release(lane="light")
+    st = g.stats()
+    assert st["inflight"] == 0 and st["shared_inflight"] == 0
+    assert all(x["inflight"] == 0 for x in st["lanes"].values())
+
+
+def test_lane_excess_over_reservation_draws_from_shared():
+    g = faults.LoadShedGate(
+        max_inflight=6, lanes=[("light", 2), ("bulk", 0)]
+    )
+    # light beyond its reservation competes in the shared pool (4)
+    for _ in range(5):
+        assert g.try_acquire(lane="light")
+    assert g.stats()["shared_inflight"] == 3
+    assert g.try_acquire(lane="bulk")  # last shared slot
+    assert not g.try_acquire(lane="bulk")
+    assert not g.try_acquire(lane="light")
+    # releasing light excess frees SHARED capacity bulk can take
+    g.release(lane="light")
+    assert g.stats()["shared_inflight"] == 3
+    assert g.try_acquire(lane="bulk")
+
+
+def test_unknown_lane_falls_back_to_first_declared():
+    g = faults.LoadShedGate(max_inflight=2, lanes=[("light", 1), ("bulk", 0)])
+    assert g.try_acquire(lane="no-such-lane")
+    assert g.stats()["lanes"]["light"]["inflight"] == 1
+    g.release(lane="no-such-lane")
+    assert g.stats()["lanes"]["light"]["inflight"] == 0
+
+
+def test_lane_config_rejects_overcommit_and_duplicates():
+    with pytest.raises(ValueError):
+        faults.LoadShedGate(max_inflight=4, lanes=[("a", 3), ("b", 2)])
+    with pytest.raises(ValueError):
+        faults.LoadShedGate(max_inflight=4, lanes=[("a", 1), ("a", 1)])
+    with pytest.raises(ValueError):
+        faults.LoadShedGate(max_inflight=4, lanes=[])
+
+
+def test_tiered_gate_concurrent_hammer_reservation_holds():
+    """Concurrent multi-peer contention: a saturating bulk flood runs
+    the whole time, yet a light worker staying within the reservation is
+    NEVER shed; accounting balances exactly when everyone drains."""
+    import threading
+
+    g = faults.LoadShedGate(
+        max_inflight=8, lanes=[("light", 4), ("bulk", 0), ("hostile", 0)]
+    )
+    stop = threading.Event()
+    light_denied = []
+
+    def bulk_flood():
+        while not stop.is_set():
+            if g.try_acquire(lane="bulk"):
+                g.release(lane="bulk")
+
+    def light_worker():
+        # 2 light workers x weight 2 = 4 == reserved: must always admit
+        for _ in range(2000):
+            if not g.try_acquire(weight=2, lane="light"):
+                light_denied.append(1)
+            else:
+                g.release(weight=2, lane="light")
+
+    floods = [threading.Thread(target=bulk_flood) for _ in range(6)]
+    lights = [threading.Thread(target=light_worker) for _ in range(2)]
+    for t in floods + lights:
+        t.start()
+    for t in lights:
+        t.join()
+    stop.set()
+    for t in floods:
+        t.join()
+    assert not light_denied, f"{len(light_denied)} light admissions denied"
+    st = g.stats()
+    assert st["inflight"] == 0
+    assert st["shared_inflight"] == 0
+    assert st["lanes"]["light"]["shed"] == 0
+    assert st["lanes"]["light"]["admitted"] == 4000
+    assert st["admitted"] + st["shed"] == sum(
+        x["admitted"] + x["shed"] for x in st["lanes"].values()
+    )
+
+
+def test_tier_policy_recent_usage_demotion_and_pinning():
+    """Deterministic tier assignment under a virtual clock: light until
+    recent usage crosses demote_rows, bulk beyond it, auto-pinned to
+    hostile at hostile_rows with a trip()-style cooldown, and the
+    sliding window forgets usage two epochs back."""
+    t, clock, _ = _virtual_time()
+    p = faults.TierPolicy(
+        demote_rows=10, hostile_rows=40, window_s=5.0,
+        pin_cooldown_s=60.0, clock=clock,
+    )
+    assert p.lane_for("") == "light"  # anonymous is always light
+    assert p.lane_for("a") == "light"  # unknown peer is light
+    p.note("a", 9)
+    assert p.lane_for("a") == "light"
+    p.note("a", 1)  # recent usage now 10 >= demote_rows
+    assert p.lane_for("a") == "bulk"
+    # window slide: one epoch later the usage is still "recent" (prev
+    # bucket), two epochs later it is forgotten
+    t["now"] = 5.0
+    assert p.lane_for("a") == "bulk"
+    t["now"] = 10.0
+    assert p.lane_for("a") == "light"
+    # auto-pin: crossing hostile_rows trips the peer for the cooldown
+    p.note("b", 40)
+    assert p.lane_for("b") == "hostile"
+    assert p.stats()["pins"] == 1
+    t["now"] = 10.0 + 60.0 + 11.0  # pin expired AND window rotated away
+    assert p.lane_for("b") == "light"
+    # manual trip()-style pinning with an explicit cooldown
+    p.pin("c", cooldown_s=30.0)
+    assert p.lane_for("c") == "hostile"
+    t["now"] += 31.0
+    assert p.lane_for("c") == "light"
+
+
+def test_tier_policy_peer_state_is_bounded():
+    """The per-peer usage table lives on an LruCache: an open swarm of
+    identities cannot grow it past max_peers, and an evicted over-asker
+    simply restarts as light."""
+    p = faults.TierPolicy(demote_rows=1, max_peers=8)
+    for i in range(64):
+        p.note(f"peer-{i}", 5)
+    assert p.stats()["peers"] == 8
+    assert p.lane_for("peer-0") == "light"  # evicted long ago
+    assert p.lane_for("peer-63") == "bulk"  # still tracked

@@ -18,7 +18,7 @@ from celestia_tpu.client.signer import Signer
 from celestia_tpu.da.blob import Blob
 from celestia_tpu.da.namespace import Namespace
 from celestia_tpu.da.proof import ShareInclusionProof
-from celestia_tpu.node.server import NodeServer
+from celestia_tpu.node.server import NodeServer, NodeService
 from celestia_tpu.node.testnode import TestNode
 from celestia_tpu.utils.secp256k1 import PrivateKey
 
@@ -151,6 +151,13 @@ def test_healthz_http_probe_and_metrics_routes():
         assert doc["alerts_firing"] == []
         assert doc["uptime_s"] >= 0
         assert doc["chain_id"] == node.chain_id
+        # DAS serving health rides the probe (no metrics scrape needed):
+        # gate shed totals + per-lane inflight; the default (no-QoS)
+        # server reports the single degenerate lane, and fairness is
+        # ABSENT until an identified peer has been served (skip-absent)
+        assert doc["das"]["gate_shed"] == 0
+        assert doc["das"]["lanes"] == {"default": 0}
+        assert "fairness_index" not in doc["das"]
         # /metrics still serves the exposition on the same port
         text = urllib.request.urlopen(
             f"{base}/metrics", timeout=30
@@ -162,3 +169,29 @@ def test_healthz_http_probe_and_metrics_routes():
             raise AssertionError("expected HTTP 404 for /other")
         except urllib.error.HTTPError as e:
             assert e.code == 404
+
+
+def test_healthz_das_block_with_qos_lanes():
+    """With QoS lanes enabled, /healthz names the per-lane inflight and
+    carries the current fairness index once an identified peer has been
+    served — serving degradation is visible from the JSON probe alone."""
+    node = TestNode(auto_produce=False)
+    node.produce_block()
+    service = NodeService(node, das_max_inflight=4, das_qos=True)
+    doc = service.healthz()
+    assert set(doc["das"]["lanes"]) == {"light", "bulk", "hostile"}
+    assert doc["das"]["gate_shed"] == 0
+    assert "fairness_index" not in doc["das"]
+    # a skewed served distribution shows up as a low fairness index
+    service.das_peers.record_served(
+        "big", cells=99, bytes_out=1, rows=[(1, 0)], lane="bulk"
+    )
+    service.das_peers.record_served(
+        "small", cells=1, bytes_out=1, rows=[(1, 1)], lane="light"
+    )
+    doc = service.healthz()
+    assert 0.0 < doc["das"]["fairness_index"] < 0.8
+    # gate pressure is mirrored too
+    assert service.das_gate.try_acquire(lane="hostile")
+    assert service.healthz()["das"]["lanes"]["hostile"] == 1
+    service.das_gate.release(lane="hostile")

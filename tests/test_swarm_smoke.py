@@ -1,0 +1,66 @@
+"""In-process swarm smoke (the tier-1 twin of `make swarm-smoke` /
+tools/swarm_smoke.py, same contract as test_das_smoke): a seeded
+mixed honest/hostile light-client swarm drives one live QoS-enabled
+node over the real gRPC boundary — lane reservation keeps the light
+tier's p99 bounded while hostile over-askers are demoted and shed, the
+per-peer/per-lane exposition stays parse-valid, and the swarm-induced
+fairness collapse fires ``das_fairness_floor`` whose transition dumps a
+valid flight-recorder incident bundle — plus a collector leg pinning
+the per-peer QoS signals ``collect_node_sample`` feeds the alert
+engine."""
+
+import importlib.util
+from pathlib import Path
+
+_spec = importlib.util.spec_from_file_location(
+    "swarm_smoke",
+    Path(__file__).resolve().parent.parent / "tools" / "swarm_smoke.py",
+)
+swarm_smoke = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(swarm_smoke)
+
+
+def test_swarm_smoke_in_process(capsys):
+    assert swarm_smoke.main() == 0
+    out = capsys.readouterr().out
+    assert '"swarm_smoke": "ok"' in out
+
+
+def test_collect_node_sample_carries_qos_signals():
+    """With a QoS-enabled service attached, the collector reports gate
+    pressure, per-lane shed counts and — only once an identified peer
+    has been served (skip-absent) — the Jain fairness index the stock
+    ``das_fairness_floor`` rule watches."""
+    from celestia_tpu.node.server import NodeService
+    from celestia_tpu.node.testnode import TestNode
+    from celestia_tpu.utils import timeseries
+
+    node = TestNode(auto_produce=False)
+    node.produce_block()
+    service = NodeService(node, das_max_inflight=4, das_qos=True)
+    values = timeseries.collect_node_sample(node)
+    assert values["das_gate_inflight"] == 0.0
+    assert values["das_lane_shed_light"] == 0.0
+    assert values["das_lane_shed_hostile"] == 0.0
+    # fairness is absent until an identified peer has been served — the
+    # stock rule self-disables on anonymous-only traffic
+    assert "das_fairness_index" not in values
+    service.das_peers.record_served(
+        "peer-a", cells=9, bytes_out=100, rows=[(1, 0)], lane="light"
+    )
+    service.das_peers.record_served(
+        "peer-b", cells=1, bytes_out=10, rows=[(1, 1)], lane="light"
+    )
+    values = timeseries.collect_node_sample(node)
+    # Jain over (9, 1): 100 / (2 * 82)
+    assert abs(values["das_fairness_index"] - 100.0 / 164.0) < 1e-9
+
+
+def test_default_rules_include_fairness_floor():
+    from celestia_tpu.utils import timeseries
+
+    rules = {r.name: r for r in timeseries.default_rules()}
+    rule = rules["das_fairness_floor"]
+    assert rule.metric == "das_fairness_index"
+    assert rule.op == "<"
+    assert rule.threshold == timeseries.DAS_FAIRNESS_FLOOR == 0.8

@@ -39,7 +39,15 @@ What counts as a headline metric (see BASELINE.md for meanings):
 * ``extras.host_profile.sampler_overhead_pct`` — judged against an
   ABSOLUTE 2% ceiling on the latest round (the continuous-profiling
   cost contract: the sampler must stay under 2% of the leg wall it
-  measures), never against best-so-far.
+  measures), never against best-so-far,
+* ``extras.swarm`` (the light-client swarm legs): every per-tier
+  ``*_p50_ms``/``*_p99_ms`` figure under the ``honest``/``hostile_mix``
+  leg blocks (lower is better; names carry the k stamp from bench so
+  different square sizes never cross-compare), and the honest-crowd
+  ``fairness_index`` — judged against an ABSOLUTE 0.8 FLOOR on the
+  latest round only (the QoS fairness contract: an honest crowd must
+  see a near-uniform served distribution; a lucky 0.99 round must not
+  turn every later 0.95 into a failure, so no best-so-far trend).
 
 Rounds whose ``parsed`` is null (a crashed bench run) contribute no
 values; they are counted and reported, never treated as zeros.
@@ -88,6 +96,15 @@ TOLERANCE_OVERRIDE = {
 # measures" — not a trajectory to trend)
 ABSOLUTE_CEILING = {
     "host_profile.sampler_overhead_pct": 2.0,
+}
+
+# metrics judged against an ABSOLUTE floor on the LATEST round only —
+# the mirror of ABSOLUTE_CEILING for contract metrics where LOW is the
+# failure: the swarm's honest-crowd Jain fairness index must stay at or
+# above the serving plane's DAS_FAIRNESS_FLOOR (the same 0.8 the stock
+# das_fairness_floor alert rule watches server-side)
+ABSOLUTE_FLOOR = {
+    "swarm.fairness_index": 0.8,
 }
 
 
@@ -174,6 +191,30 @@ def _flat_headlines(parsed: dict):
                 mv = val.get(mk)
                 if isinstance(mv, (int, float)) and not isinstance(mv, bool):
                     yield f"transfer_accounting.k{kk}.{mk}", float(mv), False
+        elif key == "swarm" and isinstance(val, dict):
+            # the light-client swarm series: per-tier latency tails
+            # under each leg (k-stamped by bench — a k=4 honest crowd
+            # never alarms against a k=8 best) plus the honest-crowd
+            # fairness index, which check() judges against the 0.8
+            # ABSOLUTE_FLOOR instead of best-so-far
+            fi = val.get("fairness_index")
+            if isinstance(fi, (int, float)) and not isinstance(fi, bool):
+                yield "swarm.fairness_index", float(fi), True
+            for leg in ("honest", "hostile_mix"):
+                block = val.get(leg)
+                if not isinstance(block, dict):
+                    continue
+                for mk, mv in sorted(block.items()):
+                    if isinstance(mv, bool) or not isinstance(
+                        mv, (int, float)
+                    ):
+                        continue
+                    # tier percentile keys carry the k stamp between the
+                    # tag and the unit: light_p99_k4_ms
+                    if mk.endswith("_ms") and (
+                        "_p50_" in mk or "_p99_" in mk
+                    ):
+                        yield f"swarm.{leg}.{mk}", float(mv), False
         elif key == "lint_stats" and isinstance(val, dict):
             # celint whole-tree wall time: the R6 whole-program pass is
             # the only tier-1 gate whose cost grows with the TREE, so
@@ -232,6 +273,30 @@ def check(rounds, tolerance: float):
                         "last": last,
                         "last_round": last_round,
                         "ratio": round(last / ceiling, 3),
+                        "tolerance": 0.0,
+                    }
+                )
+            continue
+        floor = ABSOLUTE_FLOOR.get(metric)
+        if floor is not None:
+            # absolute-floor metric: the latest round alone decides —
+            # the symmetric twin of the ceiling branch above, alarming
+            # when the contract value FALLS BELOW the floor
+            ratio = round(last / floor, 3) if floor else 1.0
+            summary[metric] = {
+                "last": last, "last_round": last_round,
+                "floor": floor, "ratio": ratio,
+            }
+            if last < floor:
+                regressions.append(
+                    {
+                        "metric": metric,
+                        "direction": "floor",
+                        "best": floor,
+                        "best_round": "(absolute floor)",
+                        "last": last,
+                        "last_round": last_round,
+                        "ratio": ratio,
                         "tolerance": 0.0,
                     }
                 )

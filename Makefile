@@ -7,7 +7,7 @@ PY ?= python
 # passes --format through; exit codes are unchanged either way
 LINT_FORMAT ?=
 
-.PHONY: lint lockwatch test chaos trace-smoke profile-smoke incident-smoke multichip-smoke das-smoke swarm-smoke device-resident-smoke mesh-live t1-budget bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
+.PHONY: lint lockwatch test chaos trace-smoke profile-smoke incident-smoke critpath-smoke multichip-smoke das-smoke swarm-smoke device-resident-smoke mesh-live t1-budget bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
 
 ## celint: concurrency & determinism static analysis (exit 1 on findings)
 lint:
@@ -61,6 +61,20 @@ profile-smoke:
 ## assertions via tests/test_incident_smoke.py)
 incident-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/incident_smoke.py
+
+## block-lifecycle critical-path boot gate: one real block through a
+## 2-node mesh must yield a non-empty critical path ending at
+## rpc.cons_commit with the attribution partition (self + queue_wait +
+## flow + gap) summing to the root wall within 1%, a POSITIVE
+## propagation delay off the _tc send timestamp, a BlockScorecard row
+## on both nodes and a named slowest validator in the mesh waterfall;
+## a second leg injects a deliberately impossible block_e2e_slo budget
+## (CELESTIA_TPU_SLO) and asserts the burn-rate firing transitions the
+## flight recorder into a manifest-valid incident bundle carrying the
+## offending trace (tier-1 runs the same assertions via
+## tests/test_critpath_smoke.py)
+critpath-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/critpath_smoke.py
 
 ## live mesh-path boot gate: a forced-multi-host-device subprocess
 ## drives one real block through prepare->process with the sharded

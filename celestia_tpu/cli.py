@@ -713,6 +713,12 @@ def cmd_query(args) -> int:
         }, indent=1))
         if firing and args.fail_on_firing:
             return 1
+    elif args.query_cmd == "block-scorecard":
+        # the per-height block scorecard ring: prepare/process walls,
+        # extend leg + cache verdict, propagation hop, commit lag and
+        # the critical-path top contributors for every recent height
+        out = node.block_scorecard(last=args.last or None)
+        print(json.dumps(out, indent=1 if args.pretty else None))
     elif args.query_cmd == "host-profile":
         out = node.host_profile(top=args.top, folded=args.folded)
         if args.out:
@@ -1765,6 +1771,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print only the rules currently firing")
     q.add_argument("--fail-on-firing", action="store_true",
                    help="exit 1 when any rule fires (CI/automation probe)")
+    q = qs.add_parser(
+        "block-scorecard",
+        help="per-height block scorecard: prepare/process walls, extend "
+             "leg, propagation delay, commit lag, critical-path top "
+             "contributors",
+    )
+    q.add_argument("--last", type=int, default=0,
+                   help="only the most recent N heights (0 = all kept)")
+    q.add_argument("--pretty", action="store_true",
+                   help="indent the JSON output")
     q = qs.add_parser(
         "host-profile",
         help="the node's host sampling-profiler view: sampler stats, "

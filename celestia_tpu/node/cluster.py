@@ -219,6 +219,93 @@ def cluster_trace(
 
 
 # ---------------------------------------------------------------------------
+# per-height mesh waterfall
+# ---------------------------------------------------------------------------
+
+
+def mesh_waterfall(doc: dict, height: Optional[int] = None) -> dict:
+    """Per-height latency waterfall across a merged mesh trace.
+
+    For every height with a block root in ``doc`` (a
+    :func:`merge_node_dumps` product — all timestamps on the
+    collector's clock axis): the proposer's prepare wall, each
+    validator's process wall with its propagation hop (``_tc`` send ts
+    shifted by the node's clock offset, clamped at 0 on skew), start /
+    end offsets relative to the proposer's prepare start, the
+    propagation SPREAD (max - min hop delay: how unevenly gossip
+    reached the mesh) and the slowest validator NAMED (latest
+    wall-clock finisher — the node actually holding up the round).
+    ``height`` filters to one height; default rolls up every height in
+    the doc.
+    """
+    from celestia_tpu.utils import critpath
+
+    spans, offsets = critpath.extract_spans(doc)
+    by_height: Dict[int, list] = {}
+    for s in spans:
+        if s.name not in critpath.BLOCK_ROOT_NAMES:
+            continue
+        try:
+            h = int(s.args.get("height"))
+        except (TypeError, ValueError):
+            continue
+        if height is not None and h != int(height):
+            continue
+        by_height.setdefault(h, []).append(s)
+
+    heights = []
+    for h in sorted(by_height):
+        roots = by_height[h]
+        proposer = None
+        for s in roots:
+            if s.name == "prepare_proposal" and (
+                proposer is None or s.t0 < proposer.t0
+            ):
+                proposer = s
+        t_zero = proposer.t0 if proposer is not None else min(s.t0 for s in roots)
+        validators = []
+        for s in sorted(
+            (x for x in roots if x.name == "process_proposal"),
+            key=lambda x: x.t0,
+        ):
+            entry = {
+                "node": s.node,
+                "process_ms": round(s.wall_ms, 3),
+                "start_ms": round((s.t0 - t_zero) * 1000.0, 3),
+                "end_ms": round((s.t1 - t_zero) * 1000.0, 3),
+            }
+            hop = critpath.hop_delay_ms(s, offsets)
+            if hop is not None:
+                entry["propagation_ms"], entry["clamped"] = hop
+            validators.append(entry)
+        delays = [
+            v["propagation_ms"] for v in validators if "propagation_ms" in v
+        ]
+        slowest = max(validators, key=lambda v: v["end_ms"], default=None)
+        row = {
+            "height": h,
+            "proposer": (
+                {
+                    "node": proposer.node,
+                    "prepare_ms": round(proposer.wall_ms, 3),
+                }
+                if proposer is not None
+                else None
+            ),
+            "validators": validators,
+            "propagation_spread_ms": (
+                round(max(delays) - min(delays), 3) if delays else None
+            ),
+            "slowest_validator": slowest["node"] if slowest else None,
+        }
+        heights.append(row)
+    return {
+        "heights": heights,
+        "nodes": sorted({s.node for s in spans if s.node}),
+    }
+
+
+# ---------------------------------------------------------------------------
 # cluster health
 # ---------------------------------------------------------------------------
 

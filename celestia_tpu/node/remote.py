@@ -224,6 +224,18 @@ class RemoteNode:
             payload["last"] = int(last)
         return self._call_json("TimeSeries", payload)
 
+    def block_scorecard(self, last: Optional[int] = None) -> dict:
+        """The node's per-height block scorecard ring (the
+        ``BlockScorecard`` RPC): ``{"node_id", "height", "rows"}`` —
+        one row per height with prepare/process walls, extend leg,
+        propagation delay, commit lag and the critical-path top
+        contributors.  The server ingests freshly completed traces
+        before answering, so a row exists right after its block."""
+        payload: dict = {}
+        if last is not None:
+            payload["last"] = int(last)
+        return self._call_json("BlockScorecard", payload)
+
     def host_profile(self, top: int = 25, folded: int = 200) -> dict:
         """The node's host sampling-profiler view (the ``HostProfile``
         RPC): ``{"stats", "top_frames", "folded"}`` — folded stacks are

@@ -66,7 +66,7 @@ import hashlib
 import json
 import threading
 from concurrent import futures
-from typing import Optional
+from typing import Dict, Optional
 
 import grpc
 
@@ -219,6 +219,98 @@ class _PeerRegistry:
         return lines
 
 
+class _BlockScorecardRing:
+    """Bounded ring of per-height block scorecards.
+
+    One row per height, merged from whatever legs THIS node actually
+    saw: the proposer contributes prepare wall, a validator contributes
+    process wall + the gossip propagation hop, and the commit RPC
+    arrival stamps commit lag.  Rows are assembled incrementally (the
+    lifecycle reaches a node as separate RPCs), and ``e2e_ms`` is
+    always the sum of the parts known so far — a proposer-only row is
+    an honest partial, not a lie.  Keys starting with ``_`` are
+    internal (raw clock stamps for lag arithmetic) and stripped from
+    served rows.
+    """
+
+    CAP = 64
+
+    def __init__(self, cap: int = CAP):
+        self._cap = int(cap)
+        self._lock = threading.Lock()
+        # height -> row; heights are monotonic, so height order IS the
+        # arrival order and eviction drops the numerically oldest —
+        # this is a ring, not a cache (no LRU touch semantics);
+        # celint: guarded-by(self._lock)
+        self._rows: Dict[int, dict] = {}
+        # celint: guarded-by(self._lock)
+        self._seen: set = set()
+
+    def first_time(self, key) -> bool:
+        """Dedupe gate for trace ingestion (ring re-reads repeat)."""
+        with self._lock:
+            if key in self._seen:
+                return False
+            if len(self._seen) > 8 * self._cap:
+                self._seen.clear()
+            self._seen.add(key)
+            return True
+
+    def _recompute(self, row: dict) -> None:
+        e2e = 0.0
+        for k in ("prepare_ms", "propagation_ms", "process_ms", "commit_lag_ms"):
+            v = row.get(k)
+            if v is not None:
+                e2e += float(v)
+        row["e2e_ms"] = round(e2e, 3)
+        end = row.get("_end_ts")
+        commit = row.get("_commit_ts")
+        if end is not None and commit is not None and "commit_lag_ms" not in row:
+            row["commit_lag_ms"] = round(max(0.0, commit - end) * 1000.0, 3)
+            self._recompute(row)
+
+    def update(self, height: int, **fields) -> dict:
+        """Merge fields into the height's row (creating it), recompute
+        the e2e rollup, trim the ring; returns a copy of the row."""
+        with self._lock:
+            row = self._rows.get(height)
+            if row is None:
+                row = {"height": int(height)}
+                self._rows[height] = row
+                if len(self._rows) > self._cap:
+                    for h in sorted(self._rows)[: len(self._rows) - self._cap]:
+                        del self._rows[h]
+            row.update({k: v for k, v in fields.items() if v is not None})
+            self._recompute(row)
+            return dict(row)
+
+    def note_commit(self, height: int, ts: float) -> dict:
+        return self.update(height, _commit_ts=ts)
+
+    def rows(self, last: Optional[int] = None) -> list:
+        with self._lock:
+            rows = [
+                {k: v for k, v in self._rows[h].items() if not k.startswith("_")}
+                for h in sorted(self._rows)
+            ]
+        if last is not None:
+            rows = rows[-int(last):]
+        return rows
+
+    def latest(self) -> Optional[dict]:
+        rows = self.rows(last=1)
+        return rows[0] if rows else None
+
+
+# extend-leg span name -> the scorecard's leg label
+_EXTEND_LEGS = {
+    "extend.native": "native",
+    "extend.jax": "jax",
+    "extend.sharded": "mesh",
+    "extend.device_plane": "device_plane",
+}
+
+
 class NodeService:
     """Method implementations over an in-process node (TestNode surface)."""
 
@@ -236,6 +328,16 @@ class NodeService:
         self.alert_engine = ts_mod.AlertEngine(ts_mod.default_rules())
         for rule in ts_mod.rules_from_env():
             self.alert_engine.add_rule(rule)
+        # block-lifecycle SLO plane (utils/timeseries.py): stock budgets
+        # with CELESTIA_TPU_SLO operator overrides — malformed config
+        # raises HERE, at boot, not at the first breach.  SLO verdicts
+        # ride the same firing-transition path as alert rules, so a
+        # breach trips the flight recorder into an incident bundle.
+        self.slos = ts_mod.effective_slos()
+        # per-height block scorecard ring, fed from completed block
+        # traces (prepare/process walls, extend leg, propagation hop,
+        # commit lag, critical-path top contributors)
+        self.scorecard = _BlockScorecardRing()
         # anomaly flight recorder (utils/flight.py): None unless the
         # operator gave --flight-dir; fed firing transitions from every
         # sampler tick / TimeSeries RPC below
@@ -441,6 +543,17 @@ class NodeService:
                     else None
                 ),
             )
+        # commit-lag stamp for the block scorecard: the lifecycle ends
+        # here, and the gap between the process/prepare trace's end and
+        # this arrival is the consensus glue the waterfall reports
+        from celestia_tpu.utils.telemetry import clock
+
+        self.scorecard.note_commit(int(q["height"]), clock())
+        try:
+            self._scorecard_ingest()
+        except Exception as e:
+            # scorecard bugs degrade observability, never consensus
+            faults.note("scorecard.commit", e)
         return json.dumps({"app_hash": app_hash.hex()}).encode()
 
     # -- two-phase BFT surface (node/bft.py; the relay is dumb transport)
@@ -852,8 +965,9 @@ class NodeService:
         )
         # alert states: one 0/1 gauge per rule + the firing total, so
         # cluster_health flags a degrading node from the scrape alone
+        # (SLO burn-rate verdicts ride the same gauge family)
         firing_total = 0
-        for verdict in self.alert_engine.evaluate(self.timeseries):
+        for verdict in self._evaluate_all():
             label = escape_label_value(verdict["name"])
             val = 1 if verdict["firing"] else 0
             firing_total += val
@@ -867,6 +981,108 @@ class NodeService:
         text bytes — point a scraper straight at the RPC."""
         return self.metrics_text().encode()
 
+    def _evaluate_all(self):
+        """Alert-rule verdicts + SLO burn-rate verdicts, one list.  The
+        flight recorder keys on verdict name/firing, so SLO breaches
+        transition into incident bundles through the unchanged path."""
+        verdicts = self.alert_engine.evaluate(self.timeseries)
+        verdicts.extend(s.evaluate(self.timeseries) for s in self.slos)
+        return verdicts
+
+    def _scorecard_ingest(self) -> None:
+        """Fold newly completed block traces into the scorecard ring.
+
+        Called on every sampler tick, scorecard RPC and commit (the
+        trace ring is tiny, ingestion dedupes on root span id, so
+        repeated calls are cheap no-ops).  Per trace: wall + slowest
+        phase from ``phase_breakdown``, extend leg + cache verdict from
+        the extend spans, the propagation hop from the critical-path
+        report (``_tc`` send ts, offset 0 on a single node's own axis —
+        clamped at 0 with ``celestia_tpu_clock_skew_clamped_total``
+        counting the skew), and the top-3 critical-path contributors.
+        The e2e/propagation observations feed the SLO metrics and the
+        ``celestia_tpu_block_{e2e,propagation}_seconds`` histograms."""
+        from celestia_tpu.utils import critpath, faults
+
+        t = self.node.app.telemetry
+        for tr in tracing.block_traces():
+            if not tr.complete or not tr.spans:
+                continue
+            if not self.scorecard.first_time((tr.name, tr.height, tr.root_id)):
+                continue
+            try:
+                report = critpath.critical_path(tr)
+                breakdown = tracing.TRACER.phase_breakdown(tr)
+            except Exception as e:
+                faults.note("scorecard.ingest", e)
+                continue
+            root = next(
+                (s for s in tr.spans if s.span_id == tr.root_id), None
+            )
+            leg, cache = "", ""
+            for s in tr.spans:
+                if s.name == "extend":
+                    cache = s.args.get("eds_cache", cache)
+                elif s.name in _EXTEND_LEGS:
+                    leg = _EXTEND_LEGS[s.name]
+            if cache == "hit" and not leg:
+                leg = "cache"
+            phases = {
+                k: v
+                for k, v in breakdown.items()
+                if k.endswith("_ms") and k != "total_ms"
+            }
+            slowest = max(phases, key=phases.get) if phases else ""
+            fields = {
+                "slowest_phase": slowest[:-3] if slowest else "",
+                "top_contributors": report["top_contributors"],
+                "_end_ts": root.t1 if root is not None else None,
+            }
+            if leg:
+                fields["extend_leg"] = leg
+            if cache:
+                fields["eds_cache"] = cache
+            wall = report["root_wall_ms"]
+            prop = report["propagation_delay_ms"]
+            if tr.name == "prepare_proposal":
+                fields["prepare_ms"] = wall
+            else:
+                fields["process_ms"] = wall
+            if prop is not None:
+                fields["propagation_ms"] = prop
+                t.observe("block_propagation", prop)
+            if report["clock_skew_clamped"]:
+                fields["propagation_clamped"] = report["clock_skew_clamped"]
+                t.incr("clock_skew_clamped", report["clock_skew_clamped"])
+            row = self.scorecard.update(tr.height, **fields)
+            t.observe("block_e2e", row["e2e_ms"])
+            obs = {"block_e2e_ms": row["e2e_ms"]}
+            if prop is not None:
+                obs["block_propagation_ms"] = prop
+            self.timeseries.record(obs)
+
+    def block_scorecard(self, req: bytes, ctx) -> bytes:
+        """The per-height scorecard ring (``query block-scorecard``).
+        Ingests any freshly completed traces first, so a scorecard
+        fetched right after a block always has that height's row."""
+        q = json.loads(req or b"{}")
+        from celestia_tpu.utils import faults
+
+        try:
+            self._scorecard_ingest()
+        except Exception as e:
+            faults.note("scorecard.rpc", e)
+        last = q.get("last")
+        return json.dumps(
+            {
+                "node_id": tracing.node_id(),
+                "height": int(getattr(self.node, "height", 0) or 0),
+                "rows": self.scorecard.rows(
+                    int(last) if last is not None else None
+                ),
+            }
+        ).encode()
+
     def sample_timeseries(self):
         """Record ONE snapshot of the node's operational signals into
         the ring (the sampler thread's tick; also the on-demand sample
@@ -877,13 +1093,20 @@ class NodeService:
         from celestia_tpu.utils import faults, timeseries as ts_mod
 
         try:
+            # scorecard first: freshly completed traces contribute the
+            # block_e2e_ms/block_propagation_ms observations the SLO
+            # verdicts below are judged on
+            self._scorecard_ingest()
+        except Exception as e:
+            faults.note("scorecard.tick", e)
+        try:
             self.timeseries.record(ts_mod.collect_node_sample(self.node))
         except Exception as e:
             # a collector bug degrades the ring, never the node
             faults.note("timeseries.sample", e)
         verdicts = None
         if self.flight is not None:
-            verdicts = self.alert_engine.evaluate(self.timeseries)
+            verdicts = self._evaluate_all()
             self.flight_tick(verdicts)
         return verdicts
 
@@ -900,7 +1123,7 @@ class NodeService:
 
         try:
             if verdicts is None:
-                verdicts = self.alert_engine.evaluate(self.timeseries)
+                verdicts = self._evaluate_all()
             inc = self.flight.on_alerts(
                 verdicts,
                 height=int(getattr(self.node, "height", 0) or 0),
@@ -928,7 +1151,7 @@ class NodeService:
         q = json.loads(req or b"{}")
         verdicts = self.sample_timeseries()
         if verdicts is None:  # no recorder armed: the tick skipped it
-            verdicts = self.alert_engine.evaluate(self.timeseries)
+            verdicts = self._evaluate_all()
         last = q.get("last")
         snapshots = self.timeseries.samples(
             int(last) if last is not None else None
@@ -1081,7 +1304,7 @@ class NodeService:
             except Exception as e:
                 faults.note("healthz.breakers", e)
         firing = [
-            a["name"] for a in self.alert_engine.firing(self.timeseries)
+            a["name"] for a in self._evaluate_all() if a["firing"]
         ]
         # DAS serving health without a metrics scrape: gate shed totals,
         # per-lane inflight, and the current fairness index (omitted
@@ -1099,6 +1322,16 @@ class NodeService:
         fairness = self.das_peers.fairness_index()
         if fairness is not None:
             das["fairness_index"] = round(fairness, 4)
+        # block-lifecycle health: the last scored height's e2e and its
+        # slowest phase, straight off the scorecard ring
+        block = {}
+        last_row = self.scorecard.latest()
+        if last_row is not None:
+            block = {
+                "height": last_row.get("height"),
+                "e2e_ms": last_row.get("e2e_ms"),
+                "slowest_phase": last_row.get("slowest_phase", ""),
+            }
         return {
             "status": "degraded" if firing else "ok",
             "node_id": tracing.node_id(),
@@ -1115,6 +1348,7 @@ class NodeService:
                 else 0
             ),
             "das": das,
+            "block": block,
         }
 
     def query(self, req: bytes, ctx) -> bytes:
@@ -1226,6 +1460,7 @@ class NodeService:
             "Block": self.block,
             "Query": self.query,
             "Metrics": self.metrics,
+            "BlockScorecard": self.block_scorecard,
             "TraceDump": self.trace_dump,
             "ClockProbe": self.clock_probe,
             "TimeSeries": self.time_series,

@@ -384,6 +384,194 @@ def rules_from_env() -> List[AlertRule]:
 
 
 # ---------------------------------------------------------------------------
+# SLO plane: per-phase latency budgets + dual-window burn-rate alerts
+# ---------------------------------------------------------------------------
+
+ENV_SLO = "CELESTIA_TPU_SLO"
+
+# Stock budgets for the block lifecycle (scorecard observations recorded
+# by node/server.py): generous for the tiny-k dev path, meaningful for a
+# production square.  The objective is the fraction of observations that
+# must land under budget; burn rate = breach fraction / error budget.
+BLOCK_E2E_BUDGET_MS = 2000.0
+PROPAGATION_BUDGET_MS = 250.0
+SLO_OBJECTIVE = 0.99
+# Dual windows (classic multiwindow burn-rate alerting): the FAST window
+# at a high burn threshold catches spikes within a couple of samples;
+# the SLOW window at a low threshold catches budgets bleeding out over
+# minutes.  Either tripping fires the SLO.
+SLO_FAST_WINDOW_S = 60.0
+SLO_SLOW_WINDOW_S = 600.0
+SLO_FAST_BURN = 14.0
+SLO_SLOW_BURN = 2.0
+
+
+class SLO:
+    """One latency budget evaluated by dual-window burn rate.
+
+    Observations are latency samples (ms) in the node TimeSeries (e.g.
+    ``block_e2e_ms`` recorded per committed height).  A sample over
+    ``budget_ms`` is a breach; breach fraction over a trailing window
+    divided by the error budget (1 - objective) is the burn rate.
+    Firing when EITHER window exceeds its threshold; the verdict dict is
+    AlertRule-shaped (``name``/``firing``/``severity``/``value``) so
+    firing transitions ride the existing flight-recorder path
+    unchanged.  Skip-absent contract: a metric with no points in the
+    slow window never fires.
+    """
+
+    __slots__ = (
+        "name",
+        "metric",
+        "budget_ms",
+        "objective",
+        "fast_window_s",
+        "slow_window_s",
+        "fast_burn",
+        "slow_burn",
+        "severity",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        metric: str,
+        budget_ms: float,
+        objective: float = SLO_OBJECTIVE,
+        fast_window_s: float = SLO_FAST_WINDOW_S,
+        slow_window_s: float = SLO_SLOW_WINDOW_S,
+        fast_burn: float = SLO_FAST_BURN,
+        slow_burn: float = SLO_SLOW_BURN,
+        severity: str = "critical",
+    ):
+        if not name or not metric:
+            raise ValueError("SLO needs a name and a metric")
+        if budget_ms <= 0:
+            raise ValueError(f"SLO {name}: budget_ms must be positive")
+        if not (0.0 < objective < 1.0):
+            raise ValueError(f"SLO {name}: objective must be in (0, 1)")
+        if fast_window_s <= 0 or slow_window_s <= 0:
+            raise ValueError(f"SLO {name}: windows must be positive")
+        self.name = name
+        self.metric = metric
+        self.budget_ms = float(budget_ms)
+        self.objective = float(objective)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.fast_burn = float(fast_burn)
+        self.slow_burn = float(slow_burn)
+        self.severity = severity
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "metric": self.metric,
+            "kind": "slo",
+            "budget_ms": self.budget_ms,
+            "objective": self.objective,
+            "fast_window_s": self.fast_window_s,
+            "slow_window_s": self.slow_window_s,
+            "fast_burn": self.fast_burn,
+            "slow_burn": self.slow_burn,
+            "severity": self.severity,
+        }
+
+    def _burn(self, pts: List[tuple]):
+        if not pts:
+            return None
+        breach = sum(1 for _, v in pts if v > self.budget_ms)
+        return (breach / len(pts)) / max(1e-9, 1.0 - self.objective)
+
+    def evaluate(self, series: TimeSeries) -> dict:
+        out = dict(self.to_dict())
+        out.update(
+            {"firing": False, "value": None, "burn_fast": None, "burn_slow": None,
+             "window": ""}
+        )
+        slow_pts = series._points(self.metric, self.slow_window_s)
+        if not slow_pts:
+            return out  # metric absent: never fires
+        fast_pts = series._points(self.metric, self.fast_window_s)
+        bf = self._burn(fast_pts)
+        bs = self._burn(slow_pts)
+        out["burn_fast"] = None if bf is None else round(bf, 3)
+        out["burn_slow"] = None if bs is None else round(bs, 3)
+        out["value"] = out["burn_fast"] if bf is not None else out["burn_slow"]
+        fast_hit = bf is not None and bf >= self.fast_burn
+        slow_hit = bs is not None and bs >= self.slow_burn
+        out["firing"] = fast_hit or slow_hit
+        out["window"] = "fast" if fast_hit else ("slow" if slow_hit else "")
+        return out
+
+
+def default_slos() -> List[SLO]:
+    """The stock block-lifecycle SLOs (scorecard-fed metrics)."""
+    return [
+        SLO(
+            "block_e2e_slo",
+            metric="block_e2e_ms",
+            budget_ms=BLOCK_E2E_BUDGET_MS,
+            severity="critical",
+        ),
+        SLO(
+            "propagation_slo",
+            metric="block_propagation_ms",
+            budget_ms=PROPAGATION_BUDGET_MS,
+            severity="warning",
+        ),
+    ]
+
+
+def slos_from_json(text: str) -> List[SLO]:
+    """Parse a JSON list of SLO objects (the SLO constructor schema).
+    Raises ValueError on malformed input — budget configuration errors
+    must be loud at boot, not silent at the first breach."""
+    try:
+        docs = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"SLO config is not valid JSON: {e}")
+    if not isinstance(docs, list):
+        raise ValueError("SLO config must be a JSON LIST of SLO objects")
+    allowed = {
+        "name", "metric", "budget_ms", "objective", "fast_window_s",
+        "slow_window_s", "fast_burn", "slow_burn", "severity",
+    }
+    out = []
+    for i, doc in enumerate(docs):
+        if not isinstance(doc, dict) or "name" not in doc or "metric" not in doc:
+            raise ValueError(f"SLO [{i}] needs at least name+metric")
+        unknown = set(doc) - allowed
+        if unknown:
+            raise ValueError(f"SLO [{i}] has unknown keys {sorted(unknown)}")
+        if "budget_ms" not in doc:
+            raise ValueError(f"SLO [{i}] needs budget_ms")
+        kw = dict(doc)
+        out.append(SLO(kw.pop("name"), **kw))
+    return out
+
+
+def effective_slos() -> List[SLO]:
+    """Stock SLOs with operator overrides applied (CELESTIA_TPU_SLO).
+
+    An env SLO whose name matches a stock one REPLACES it (that is the
+    override path); unmatched names append.  Malformed JSON raises —
+    same loud-at-boot contract as ``rules_from_json``.
+    """
+    slos = default_slos()
+    raw = os.environ.get(ENV_SLO, "").strip()
+    if not raw:
+        return slos
+    by_name = {s.name: i for i, s in enumerate(slos)}
+    for s in slos_from_json(raw):
+        if s.name in by_name:
+            slos[by_name[s.name]] = s
+        else:
+            slos.append(s)
+    return slos
+
+
+# ---------------------------------------------------------------------------
 # the node snapshot collector
 # ---------------------------------------------------------------------------
 

@@ -151,6 +151,39 @@ def test_multichip_series_watched(tmp_path, capsys):
     assert bench_check.main(["--dir", str(tmp_path)]) == 0
 
 
+def test_critpath_series_watched(tmp_path, capsys):
+    """extras.critpath: the k-stamped critical-path figures are watched
+    lower-is-better; a regressed round fails and NAMES the series."""
+    extras_good = {"critpath": {
+        "square": 128,
+        "critical_path_ms_k128": 40.0,
+        "unattributed_gap_ms_k128": 2.0,
+        "propagation_delay_ms_k128": 0.5,
+        "clock_skew_clamped": 0,
+    }}
+    extras_bad = {"critpath": {
+        "square": 128,
+        "critical_path_ms_k128": 120.0,  # 3x the best: past tolerance
+        "unattributed_gap_ms_k128": 2.0,
+        "propagation_delay_ms_k128": 0.5,
+        "clock_skew_clamped": 0,
+    }}
+    _write_rounds(tmp_path, [
+        _round(1, extras=extras_good),
+        _round(2, extras=extras_bad),
+    ])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "critpath.critical_path_ms_k128" in err
+    # steady figures pass; the non-ms clock_skew_clamped is NOT a series
+    _write_rounds(tmp_path, [
+        _round(1, extras=extras_good),
+        _round(2, extras={"critpath": dict(
+            extras_good["critpath"], clock_skew_clamped=5)}),
+    ])
+    assert bench_check.main(["--dir", str(tmp_path)]) == 0
+
+
 def test_unparsed_rounds_are_skipped_not_zeroed(tmp_path):
     _write_rounds(tmp_path, [
         _round(1, value=10.0),

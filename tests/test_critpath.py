@@ -1,0 +1,274 @@
+"""Block-lifecycle critical-path analyzer (utils/critpath.py).
+
+The core invariant under test is the PARTITION identity: the backward
+sweep over the anchor root's subtree emits segments whose durations sum
+exactly to the root span's wall — every millisecond lands in exactly
+one of {self, queue_wait, flow, gap}.  Plus: gap decomposition reusing
+the ``phase_breakdown`` names, queue-wait via async b/e pairs,
+cross-node propagation delays off raw ``remote_send_ts`` + clock
+offsets with negative deltas CLAMPED (never negative seconds), hop
+dedup (rpc envelope vs block root carrying the same context), commit
+extension, height filtering, unresolvable-link accounting, and the
+live BlockTrace input path."""
+
+import time
+
+import pytest
+
+from celestia_tpu.utils import critpath, tracing
+
+US = 1_000_000
+
+
+@pytest.fixture
+def tracer():
+    tracing.disable()
+    tracing.clear()
+    tracing.enable(8)
+    yield tracing
+    tracing.disable()
+    tracing.clear()
+
+
+def _x(name, sid, ts, dur, parent=0, pid=1, cat="block", **extra):
+    return {
+        "ph": "X", "name": name, "cat": cat, "pid": pid, "tid": 1,
+        "ts": ts * US, "dur": dur * US,
+        "args": {"span_id": sid, "parent_id": parent, **extra},
+    }
+
+
+def _doc(events, nodes=None, node_id=""):
+    other = {}
+    if nodes:
+        other["nodes"] = nodes
+    if node_id:
+        other["node_id"] = node_id
+    return {"traceEvents": events, "otherData": other}
+
+
+def _identity(report):
+    got = sum(report["root_attribution_ms"].values())
+    wall = report["root_wall_ms"]
+    assert abs(got - wall) <= max(0.01 * wall, 0.01), (got, wall)
+
+
+# ---------------------------------------------------------------------------
+# the partition identity + gap decomposition
+# ---------------------------------------------------------------------------
+
+
+def test_partition_identity_and_gap_phases():
+    # root [0, 1.0] with a nested extend leg and a detached leaf: the
+    # sweep must cover gaps before/between/after children at BOTH
+    # levels, named like phase_breakdown's untraced accounting
+    doc = _doc([
+        _x("prepare_proposal", 1, 0.0, 1.0, height=3),
+        _x("extend", 2, 0.1, 0.4, parent=1),
+        _x("extend.jax", 3, 0.15, 0.25, parent=2),
+        _x("sign", 4, 0.6, 0.2, parent=1),
+    ])
+    report = critpath.critical_path(doc)
+    assert report["root"]["name"] == "prepare_proposal"
+    assert report["height"] == 3
+    assert report["root_wall_ms"] == pytest.approx(1000.0, abs=0.01)
+    _identity(report)
+    # gap names: the ROOT's uncovered time is plain untraced_ms; the
+    # extend span's uncovered time is extend_untraced_ms
+    gaps = report["gap_by_phase_ms"]
+    assert gaps["untraced_ms"] == pytest.approx(400.0, abs=0.01)
+    assert gaps["extend_untraced_ms"] == pytest.approx(150.0, abs=0.01)
+    attr = report["attribution_ms"]
+    assert attr["self"] == pytest.approx(450.0, abs=0.01)  # jax + sign
+    assert attr["gap"] == pytest.approx(550.0, abs=0.01)
+    assert attr["flow"] == 0.0 and attr["queue_wait"] == 0.0
+    # no commit span in the doc: the chain honestly ends at the root
+    assert report["end"]["name"] == "prepare_proposal"
+    assert report["commit_lag_ms"] is None
+    # top contributors are (node, name, kind) rollups, largest first
+    top = report["top_contributors"]
+    assert top[0]["ms"] >= top[-1]["ms"]
+    assert {"node", "name", "kind", "ms"} <= set(top[0])
+
+
+def test_queue_wait_from_async_pairs():
+    # a hostpool queue_wait rides as a b/e pair (matched on pid+id)
+    doc = _doc([
+        _x("process_proposal", 1, 0.0, 1.0, height=2),
+        {
+            "ph": "b", "name": "hostpool.queue_wait", "cat": "hostpool",
+            "pid": 1, "tid": 1, "id": "q1", "ts": 0.2 * US,
+            "args": {"span_id": 7, "parent_id": 1},
+        },
+        {"ph": "e", "name": "hostpool.queue_wait", "cat": "hostpool",
+         "pid": 1, "tid": 1, "id": "q1", "ts": 0.7 * US},
+    ])
+    report = critpath.critical_path(doc)
+    _identity(report)
+    assert report["attribution_ms"]["queue_wait"] == pytest.approx(
+        500.0, abs=0.01
+    )
+    assert report["attribution_ms"]["gap"] == pytest.approx(500.0, abs=0.01)
+    # an unmatched b event (still open at dump time) is ignored
+    doc["traceEvents"].append(
+        {"ph": "b", "name": "hostpool.queue_wait", "cat": "hostpool",
+         "pid": 1, "tid": 1, "id": "q2", "ts": 0.9 * US,
+         "args": {"span_id": 9, "parent_id": 1}}
+    )
+    _identity(critpath.critical_path(doc))
+
+
+# ---------------------------------------------------------------------------
+# cross-node: propagation, clamping, dedup, unresolved links
+# ---------------------------------------------------------------------------
+
+
+def _mesh_nodes(offset_a=0.0, offset_b=0.0):
+    return [
+        {"node_id": "val-a", "pid": 1, "clock_offset_s": offset_a},
+        {"node_id": "val-b", "pid": 2, "clock_offset_s": offset_b},
+    ]
+
+
+def test_propagation_delay_uses_offsets_and_flow_edge():
+    # send at 10.06 on val-a's clock, val-a runs 0.01 ahead -> 10.05 on
+    # the collector axis; receive at 10.10 -> 50 ms hop
+    doc = _doc(
+        [
+            _x("prepare_proposal", 1, 10.0, 0.05, pid=1, height=4),
+            _x("process_proposal", 5, 10.10, 0.08, pid=2, height=4,
+               remote_node="val-a", remote_span=1, remote_send_ts=10.06),
+        ],
+        nodes=_mesh_nodes(offset_a=0.01),
+    )
+    report = critpath.critical_path(doc)
+    assert report["propagation_delay_ms"] == pytest.approx(50.0, abs=0.01)
+    assert report["clock_skew_clamped"] == 0
+    assert report["attribution_ms"]["flow"] == pytest.approx(50.0, abs=0.01)
+    _identity(report)
+    # the upstream scope swept the origin's subtree up to the send ts
+    assert any(s["scope"] == "upstream" for s in report["steps"])
+    (hop,) = report["propagation"]
+    assert hop["from_node"] == "val-a" and hop["to_node"] == "val-b"
+    assert not hop["clamped"]
+
+
+def test_negative_delta_clamps_to_zero_never_negative():
+    # the send timestamp lands AFTER the receive (offset noise): the
+    # hop reports 0, flags clamped, and the report counts it
+    doc = _doc(
+        [
+            _x("prepare_proposal", 1, 10.0, 0.05, pid=1, height=4),
+            _x("process_proposal", 5, 10.10, 0.08, pid=2, height=4,
+               remote_node="val-a", remote_span=1, remote_send_ts=10.30),
+        ],
+        nodes=_mesh_nodes(),
+    )
+    report = critpath.critical_path(doc)
+    assert report["propagation_delay_ms"] == 0.0
+    assert report["clock_skew_clamped"] == 1
+    (hop,) = report["propagation"]
+    assert hop["delay_ms"] == 0.0 and hop["clamped"]
+    assert all(s["ms"] >= 0.0 for s in report["steps"])
+    _identity(report)
+    # hop_delay_ms agrees with the report
+    spans, offsets = critpath.extract_spans(doc)
+    recv = [s for s in spans if s.span_id == 5][0]
+    assert critpath.hop_delay_ms(recv, offsets) == (0.0, True)
+    assert critpath.hop_delay_ms(
+        [s for s in spans if s.span_id == 1][0], offsets
+    ) is None
+
+
+def test_hops_deduped_rpc_envelope_vs_block_root():
+    # the rpc.cons_process envelope and the process root it contains
+    # carry the SAME context: one hop, the earliest receipt wins
+    doc = _doc(
+        [
+            _x("prepare_proposal", 1, 10.0, 0.05, pid=1, height=4),
+            _x("rpc.cons_process", 4, 10.08, 0.20, pid=2, cat="rpc",
+               remote_node="val-a", remote_span=1, remote_send_ts=10.06),
+            _x("process_proposal", 5, 10.10, 0.08, pid=2, parent=4,
+               height=4, remote_node="val-a", remote_span=1,
+               remote_send_ts=10.06),
+        ],
+        nodes=_mesh_nodes(),
+    )
+    hops = critpath.propagation_delays(doc)
+    assert len(hops) == 1
+    # earliest receiving span = the rpc envelope at 10.08 -> 20 ms
+    assert hops[0]["name"] == "rpc.cons_process"
+    assert hops[0]["delay_ms"] == pytest.approx(20.0, abs=0.01)
+
+
+def test_unresolvable_origin_counted_flow_still_attributed():
+    # the anchor's origin span is not in the doc (partial collection):
+    # the flow edge still lands off the raw send ts, and the report
+    # says the link did not resolve
+    doc = _doc(
+        [
+            _x("process_proposal", 5, 10.10, 0.08, pid=2, height=4,
+               remote_node="val-a", remote_span=77, remote_send_ts=10.06),
+        ],
+        nodes=_mesh_nodes(),
+    )
+    report = critpath.critical_path(doc)
+    assert report["unresolved_links"] == 1
+    assert report["propagation_delay_ms"] == pytest.approx(40.0, abs=0.01)
+    assert report["attribution_ms"]["flow"] == pytest.approx(40.0, abs=0.01)
+    assert not any(s["scope"] == "upstream" for s in report["steps"])
+    _identity(report)
+
+
+# ---------------------------------------------------------------------------
+# anchor selection, commit extension, degenerate inputs
+# ---------------------------------------------------------------------------
+
+
+def test_commit_extension_and_height_filter():
+    doc = _doc([
+        _x("prepare_proposal", 1, 10.0, 0.1, height=1),
+        _x("rpc.cons_commit", 2, 10.15, 0.02, cat="rpc"),
+        _x("prepare_proposal", 3, 20.0, 0.1, height=2),
+    ])
+    # default: the LATEST block root anchors (height 2, no commit after)
+    assert critpath.critical_path(doc)["height"] == 2
+    # height filter picks the earlier block and extends through commit
+    report = critpath.critical_path(doc, height=1)
+    assert report["height"] == 1
+    assert report["end"]["name"] == "rpc.cons_commit"
+    assert report["commit_lag_ms"] == pytest.approx(50.0, abs=0.01)
+    assert report["gap_by_phase_ms"]["commit_lag"] == pytest.approx(
+        50.0, abs=0.01
+    )
+    # total = root wall + commit handoff + commit span
+    assert report["total_ms"] == pytest.approx(170.0, abs=0.1)
+
+
+def test_empty_doc_and_bad_source():
+    report = critpath.critical_path(_doc([]))
+    assert report["root"] is None and report["steps"] == []
+    assert report["total_ms"] == 0.0
+    assert critpath.propagation_delays(_doc([])) == []
+    with pytest.raises(TypeError):
+        critpath.critical_path(42)
+
+
+def test_blocktrace_input_path(tracer):
+    # the live path: a real traced block straight off the tracer ring,
+    # no Chrome round trip
+    with tracing.block_span("prepare_proposal", height=9):
+        with tracing.span("extend"):
+            with tracing.span("extend.jax"):
+                time.sleep(0.002)
+        time.sleep(0.001)
+    tr = [t for t in tracing.block_traces() if t.height == 9][0]
+    report = critpath.critical_path(tr)
+    assert report["root"]["name"] == "prepare_proposal"
+    assert report["height"] == 9
+    _identity(report)
+    assert report["attribution_ms"]["self"] > 0.0
+    names = {s["name"] for s in report["steps"]}
+    assert "extend.jax" in names
+    # BlockTrace input has one process, one clock: no offsets, no hops
+    assert report["propagation"] == []

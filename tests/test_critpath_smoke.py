@@ -1,0 +1,229 @@
+"""In-process critpath-smoke assertions (the tier-1 twin of `make
+critpath-smoke` / tools/critpath_smoke.py, same contract as
+test_incident_smoke.py): one real block driven through the
+ConsPrepare/ConsProcess/ConsCommit handlers must yield a critical path
+that ends at ``rpc.cons_commit`` with the attribution partition
+summing to the root wall within 1% and a positive propagation hop off
+the ``_tc`` send timestamp; the scorecard serves the height's row; a
+deliberately impossible ``block_e2e_slo`` budget injected via
+CELESTIA_TPU_SLO fires on the first sampler tick and transitions the
+flight recorder into a manifest-valid bundle carrying the offending
+trace; malformed SLO config is loud at boot; and ``mesh_waterfall``
+names the slowest validator on a merged two-node doc."""
+
+import json
+
+import pytest
+
+from celestia_tpu.node import cluster
+from celestia_tpu.node.server import NodeService
+from celestia_tpu.node.testnode import TestNode
+from celestia_tpu.utils import critpath, tracing
+from celestia_tpu.utils.flight import FlightRecorder, validate_manifest
+
+TIGHT_SLO = {
+    "name": "block_e2e_slo",
+    "metric": "block_e2e_ms",
+    "budget_ms": 0.001,
+    "objective": 0.5,
+    "fast_window_s": 60.0,
+    "slow_window_s": 600.0,
+    "fast_burn": 1.0,
+    "slow_burn": 1.5,
+    "severity": "critical",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    tracing.set_node_id("critpath-twin", force=True)
+    tracing.disable()
+    tracing.clear()
+    yield
+    tracing.disable()
+    tracing.clear()
+    tracing.set_node_id("", force=True)
+
+
+def _drive_block(svc) -> int:
+    """One real block through the consensus handlers (bytes->bytes, the
+    same callables the gRPC server registers), forwarding the prepare
+    root's ``_tc`` into process and commit like the coordinator does."""
+    st = json.loads(svc.status(b"{}", None))
+    prep = json.loads(svc.cons_prepare(b"{}", None))
+    tc = prep.get("_tc")
+    svc.cons_process(
+        json.dumps(
+            {
+                "block_txs": prep["block_txs"],
+                "square_size": prep["square_size"],
+                "data_root": prep["data_root"],
+                "_tc": tc,
+            }
+        ).encode(),
+        None,
+    )
+    now_ns = int(st.get("time_ns") or st.get("genesis_time_ns") or 0) + 10**9
+    svc.cons_commit(
+        json.dumps(
+            {
+                "block_txs": prep["block_txs"],
+                "height": int(st["height"]) + 1,
+                "time_ns": now_ns,
+                "data_root": prep["data_root"],
+                "square_size": prep["square_size"],
+                "_tc": tc,
+            }
+        ).encode(),
+        None,
+    )
+    return int(st["height"]) + 1
+
+
+def test_critpath_chain_ends_at_commit_and_partitions():
+    tracing.enable(4)
+    node = TestNode(auto_produce=False)
+    svc = NodeService(node)
+    height = _drive_block(svc)
+
+    report = critpath.critical_path(tracing.trace_dump())
+    assert report["root"] and report["steps"]
+    assert report["end"]["name"] == "rpc.cons_commit"
+    assert report["commit_lag_ms"] is not None
+    # the acceptance identity: anchor-root segments partition the wall
+    wall = report["root_wall_ms"]
+    got = sum(report["root_attribution_ms"].values())
+    assert abs(got - wall) <= max(0.01 * wall, 0.01), (got, wall)
+    # the _tc handoff between prepare's response and process's receipt
+    # is a real, positive propagation hop (same clock: never clamped)
+    assert report["propagation_delay_ms"] is not None
+    assert report["propagation_delay_ms"] > 0.0
+    assert report["clock_skew_clamped"] == 0
+    # every sum in the report is internally consistent
+    assert report["total_ms"] == pytest.approx(
+        sum(report["attribution_ms"].values()), abs=0.01
+    )
+
+    # the scorecard served the height's row with a live e2e rollup
+    card = json.loads(svc.block_scorecard(b"{}", None))
+    row = next(r for r in card["rows"] if r["height"] == height)
+    assert row["e2e_ms"] > 0.0
+    assert row.get("prepare_ms") or row.get("process_ms")
+    assert row.get("commit_lag_ms") is not None
+    # /healthz carries the block section
+    doc = svc.healthz()
+    assert doc["block"]["height"] == height
+    assert doc["block"]["e2e_ms"] == row["e2e_ms"]
+    json.dumps(doc)
+
+
+def test_slo_firing_trips_flight_with_offending_trace(tmp_path, monkeypatch):
+    monkeypatch.setenv("CELESTIA_TPU_SLO", json.dumps([TIGHT_SLO]))
+    tracing.enable(4)
+    node = TestNode(auto_produce=False)
+    rec = FlightRecorder(str(tmp_path / "flight"), min_interval_s=0.0)
+    svc = NodeService(node, flight=rec)
+    assert any(s.name == "block_e2e_slo" for s in svc.slos)
+    height = _drive_block(svc)
+
+    # commit already ingested the block_e2e_ms observation; the first
+    # sampler tick evaluates the SLO, the firing transition trips the
+    # recorder (no for_s on burn-rate verdicts)
+    svc.sample_timeseries()
+    incidents = svc.flight.list_incidents()
+    assert incidents, "SLO firing produced no incident bundle"
+    inc = incidents[-1]
+    assert "block_e2e_slo" in inc["reason"]
+
+    bundle = svc.flight.load_bundle(inc["id"])
+    assert validate_manifest(bundle["manifest"]) == []
+    trace = json.loads(bundle["files"]["trace.json"])
+    assert tracing.validate_chrome_trace(trace) == []
+    # the bundle carries the OFFENDING trace: the breached block's
+    # lifecycle spans are in the doc
+    assert any(
+        ev.get("name") == "prepare_proposal" for ev in trace["traceEvents"]
+    )
+    # the bundled verdicts name the SLO as firing
+    verdicts = json.loads(bundle["files"]["alerts.json"])["verdicts"]
+    assert any(
+        v["name"] == "block_e2e_slo" and v["firing"] for v in verdicts
+    )
+    # the probe degrades and names the SLO; the block section is live
+    hz = svc.healthz()
+    assert hz["status"] == "degraded"
+    assert "block_e2e_slo" in hz["alerts_firing"]
+    assert hz["block"]["height"] == height
+
+
+def test_malformed_slo_env_is_loud_at_boot(monkeypatch):
+    monkeypatch.setenv("CELESTIA_TPU_SLO", "{not json")
+    with pytest.raises(ValueError):
+        NodeService(TestNode(auto_produce=False))
+    monkeypatch.setenv(
+        "CELESTIA_TPU_SLO", json.dumps([{"name": "x", "metric": "m"}])
+    )
+    with pytest.raises(ValueError):
+        NodeService(TestNode(auto_produce=False))
+
+
+def _merged_two_node_doc():
+    """A hand-built merge_node_dumps-shaped doc: prepare on val-a,
+    process on val-b carrying the cross-node context, commit on val-b.
+    Timestamps are already on the collector axis (as the merge tool
+    leaves them); remote_send_ts rides RAW on val-a's clock, whose
+    offset is +0.02 s."""
+    us = 1_000_000
+    return {
+        "traceEvents": [
+            {
+                "ph": "X", "name": "prepare_proposal", "cat": "block",
+                "pid": 1, "tid": 1, "ts": 10.0 * us, "dur": 0.1 * us,
+                "args": {"span_id": 1, "parent_id": 0, "height": 7},
+            },
+            {
+                "ph": "X", "name": "process_proposal", "cat": "block",
+                "pid": 2, "tid": 1, "ts": 10.12 * us, "dur": 0.05 * us,
+                "args": {
+                    "span_id": 5, "parent_id": 0, "height": 7,
+                    "remote_node": "val-a", "remote_span": 1,
+                    "remote_send_ts": 10.09,
+                },
+            },
+            {
+                "ph": "X", "name": "rpc.cons_commit", "cat": "rpc",
+                "pid": 2, "tid": 1, "ts": 10.18 * us, "dur": 0.01 * us,
+                "args": {"span_id": 9, "parent_id": 0},
+            },
+        ],
+        "otherData": {
+            "nodes": [
+                {"node_id": "val-a", "pid": 1, "clock_offset_s": 0.02},
+                {"node_id": "val-b", "pid": 2, "clock_offset_s": -0.01},
+            ],
+            "cross_node_flows": 1,
+        },
+    }
+
+
+def test_mesh_waterfall_names_slowest_validator():
+    wf = cluster.mesh_waterfall(_merged_two_node_doc())
+    assert wf["nodes"] == ["val-a", "val-b"]
+    (row,) = wf["heights"]
+    assert row["height"] == 7
+    assert row["proposer"]["node"] == "val-a"
+    assert row["proposer"]["prepare_ms"] == pytest.approx(100.0, abs=0.01)
+    (v,) = row["validators"]
+    assert v["node"] == "val-b"
+    # hop = process start − (send_ts − offset) = 10.12 − 10.07 = 50 ms
+    assert v["propagation_ms"] == pytest.approx(50.0, abs=0.01)
+    assert not v["clamped"]
+    assert row["slowest_validator"] == "val-b"
+
+    report = critpath.critical_path(_merged_two_node_doc())
+    assert report["root"]["name"] == "process_proposal"
+    assert report["end"]["name"] == "rpc.cons_commit"
+    assert report["propagation_delay_ms"] == pytest.approx(50.0, abs=0.01)
+    assert report["attribution_ms"]["flow"] == pytest.approx(50.0, abs=0.01)
+    # commit handoff gap: 10.18 − 10.17 = 10 ms
+    assert report["commit_lag_ms"] == pytest.approx(10.0, abs=0.01)

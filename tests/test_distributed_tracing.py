@@ -142,6 +142,102 @@ def test_merge_emits_flow_links_and_skips_unresolvable():
     assert 1000.0 <= s["ts"] <= 1400.0
 
 
+def test_merge_missing_or_zero_offset_defaults_to_unshifted():
+    """A part with no ``clock_offset_s`` at all (an old collector, or a
+    probe that failed) merges with its timestamps UNSHIFTED — identical
+    to an explicit zero — and the merged nodes table still carries the
+    node so downstream consumers (critpath, mesh_waterfall) resolve
+    its pid."""
+    from celestia_tpu.utils import critpath
+
+    spans = [(7, "prepare_proposal", 1000.0, 400.0, {"height": 5})]
+    with_zero = cluster.merge_node_dumps([
+        {"node_id": "val-A", "clock_offset_s": 0.0,
+         "trace": _dump("val-A", spans)},
+    ])
+    without = cluster.merge_node_dumps([
+        {"node_id": "val-A", "trace": _dump("val-A", spans)},
+    ])
+    for merged in (with_zero, without):
+        assert tracing.validate_chrome_trace(merged) == []
+        (x,) = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert x["ts"] == pytest.approx(1000.0)
+        _, offsets = critpath.extract_spans(merged)
+        assert offsets.get("val-A", 0.0) == 0.0
+
+
+def test_critpath_over_merge_with_mixed_resolvable_links():
+    """One resolvable cross-node link (the rpc envelope) next to an
+    UNRESOLVABLE one on the anchor root (the origin's dump was not
+    collected): the merge emits exactly one flow, and the analyzer
+    still attributes the anchor's flow edge off the raw send ts while
+    reporting the dangling link."""
+    from celestia_tpu.utils import critpath
+
+    parts = [
+        {
+            "node_id": "val-A",
+            "trace": _dump("val-A", [(7, "gossip.push", 1000.0, 400.0, {})]),
+        },
+        {
+            "node_id": "val-B",
+            "trace": _dump(
+                "val-B",
+                [
+                    # resolvable: val-A span 7 exists in the collection
+                    (9, "rpc.das_sample", 2000.0, 300.0,
+                     {"remote_node": "val-A", "remote_span": 7}),
+                    # the ANCHOR's link is unresolvable: val-C was never
+                    # collected, but its send ts still rides the args
+                    (10, "process_proposal", 2500.0, 500.0,
+                     {"height": 3, "remote_node": "val-C",
+                      "remote_span": 555, "remote_send_ts": 0.0021}),
+                ],
+            ),
+        },
+    ]
+    merged = cluster.merge_node_dumps(parts)
+    assert tracing.validate_chrome_trace(merged) == []
+    assert merged["otherData"]["cross_node_flows"] == 1
+    report = critpath.critical_path(merged)
+    assert report["root"]["name"] == "process_proposal"
+    assert report["unresolved_links"] == 1
+    # flow edge = anchor start (2500 us) - send ts (2100 us) = 0.4 ms;
+    # val-C has no offset row, so the raw send ts rides unshifted
+    assert report["propagation_delay_ms"] == pytest.approx(0.4, abs=0.01)
+    assert report["attribution_ms"]["flow"] == pytest.approx(0.4, abs=0.01)
+
+
+def test_merge_tolerates_zero_span_dump():
+    """A node that was up but never traced a block contributes a dump
+    with NO X events: the merge must keep its track (pid + process
+    name), count zero flows from it, and the analyzer must anchor off
+    the other node unbothered."""
+    from celestia_tpu.utils import critpath
+
+    merged = cluster.merge_node_dumps([
+        {"node_id": "val-A",
+         "trace": _dump("val-A",
+                        [(7, "prepare_proposal", 1000.0, 400.0,
+                          {"height": 2})])},
+        {"node_id": "val-quiet", "trace": _dump("val-quiet", [])},
+    ])
+    assert tracing.validate_chrome_trace(merged) == []
+    names = [
+        e["args"]["name"] for e in merged["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    ]
+    assert names == ["val-A", "val-quiet"]
+    assert {n["node_id"] for n in merged["otherData"]["nodes"]} == {
+        "val-A", "val-quiet"
+    }
+    report = critpath.critical_path(merged)
+    assert report["root"] == {
+        "name": "prepare_proposal", "node": "val-A", "span_id": 7,
+    }
+    assert report["root_wall_ms"] == pytest.approx(0.4, abs=0.001)
+
+
 def test_wire_context_shape_and_malformed_tolerance(tracer):
     tracing.set_node_id("ctx-node", force=True)
     with tracing.block_span("prepare_proposal", height=3):

@@ -227,3 +227,111 @@ def test_degradation_trips_stock_rule():
     finally:
         # the degradation log is process-wide; leave it as found
         faults.reset_stats()
+
+
+# ---------------------------------------------------------------------------
+# SLO plane: budgets + dual-window burn rate
+# ---------------------------------------------------------------------------
+
+
+def _slo(**kw):
+    from celestia_tpu.utils.timeseries import SLO
+
+    base = dict(
+        metric="block_e2e_ms", budget_ms=100.0, objective=0.99,
+        fast_window_s=60.0, slow_window_s=600.0,
+        fast_burn=14.0, slow_burn=2.0,
+    )
+    base.update(kw)
+    return SLO(base.pop("name", "block_e2e_slo"), **base)
+
+
+def test_slo_fast_window_catches_spike():
+    """A burst of breaches inside the fast window fires immediately even
+    though most of the slow window is healthy (page-on-spike)."""
+    pts = [(float(t), 10.0) for t in range(0, 500, 50)]  # healthy history
+    pts += [(580.0 + i, 500.0) for i in range(10)]  # fresh burst
+    v = _slo().evaluate(_series(pts, metric="block_e2e_ms"))
+    assert v["firing"] and v["window"] == "fast"
+    # every fast-window point breaches: burn = 1.0 / (1 - 0.99) = 100
+    assert v["burn_fast"] == pytest.approx(100.0)
+    assert v["value"] == v["burn_fast"]
+    # the verdict is AlertRule-shaped for the flight recorder
+    assert {"name", "firing", "severity", "value"} <= set(v)
+    assert v["kind"] == "slo"
+
+
+def test_slo_slow_window_catches_slow_burn():
+    """Breaches spread thin: no single fast window trips, but the slow
+    window's steady error rate exceeds its budget multiple."""
+    slo = _slo(objective=0.5, fast_burn=100.0, slow_burn=1.2)
+    # ~70% breach rate spread over 10 minutes; the last 60 s are CLEAN
+    pts = [(float(t), 500.0 if t % 50 < 40 else 10.0)
+           for t in range(0, 540, 10)]
+    pts += [(545.0 + i, 10.0) for i in range(10)]
+    v = slo.evaluate(_series(pts, metric="block_e2e_ms"))
+    assert v["firing"] and v["window"] == "slow"
+    assert v["burn_fast"] < slo.fast_burn
+    assert v["burn_slow"] >= slo.slow_burn
+
+
+def test_slo_quiet_under_budget_and_on_absent_metric():
+    pts = [(float(t), 50.0) for t in range(0, 300, 10)]
+    v = _slo().evaluate(_series(pts, metric="block_e2e_ms"))
+    assert not v["firing"] and v["window"] == ""
+    assert v["burn_fast"] == 0.0 and v["burn_slow"] == 0.0
+    # metric absent entirely: never fires, honest None value
+    v = _slo().evaluate(_series(pts, metric="something_else"))
+    assert not v["firing"] and v["value"] is None
+
+
+def test_slo_validation_is_loud():
+    from celestia_tpu.utils.timeseries import SLO
+
+    with pytest.raises(ValueError):
+        SLO("", metric="m", budget_ms=1.0)
+    with pytest.raises(ValueError):
+        _slo(budget_ms=0.0)
+    with pytest.raises(ValueError):
+        _slo(objective=1.0)
+    with pytest.raises(ValueError):
+        _slo(fast_window_s=0.0)
+
+
+def test_slos_from_json_schema_errors():
+    from celestia_tpu.utils.timeseries import slos_from_json
+
+    good = json.dumps([{"name": "x", "metric": "m", "budget_ms": 5.0}])
+    (s,) = slos_from_json(good)
+    assert s.name == "x" and s.budget_ms == 5.0
+    for bad in (
+        "{not json",
+        '{"name": "x"}',  # not a list
+        '[{"metric": "m", "budget_ms": 1}]',  # no name
+        '[{"name": "x", "metric": "m"}]',  # no budget_ms
+        '[{"name": "x", "metric": "m", "budget_ms": 1, "nope": 2}]',
+    ):
+        with pytest.raises(ValueError):
+            slos_from_json(bad)
+
+
+def test_effective_slos_env_override(monkeypatch):
+    # no env: the stock pair
+    monkeypatch.delenv(ts_mod.ENV_SLO, raising=False)
+    names = [s.name for s in ts_mod.effective_slos()]
+    assert names == ["block_e2e_slo", "propagation_slo"]
+    # same name REPLACES the stock budget; a new name appends
+    monkeypatch.setenv(ts_mod.ENV_SLO, json.dumps([
+        {"name": "block_e2e_slo", "metric": "block_e2e_ms",
+         "budget_ms": 123.0},
+        {"name": "custom_slo", "metric": "das_p99_ms", "budget_ms": 9.0},
+    ]))
+    slos = ts_mod.effective_slos()
+    assert [s.name for s in slos] == [
+        "block_e2e_slo", "propagation_slo", "custom_slo"
+    ]
+    assert slos[0].budget_ms == 123.0
+    # malformed config is loud, not silently stock
+    monkeypatch.setenv(ts_mod.ENV_SLO, "[{]")
+    with pytest.raises(ValueError):
+        ts_mod.effective_slos()

@@ -139,6 +139,18 @@ def _flat_headlines(parsed: dict):
                         and not isinstance(pv, bool)
                     ):
                         yield f"trace_summary.{block}.{pk}", float(pv), False
+        elif key == "critpath" and isinstance(val, dict):
+            # critical-path attribution of the traced lifecycle: the
+            # path wall, the unattributed gap and the testnode-leg
+            # propagation delay are all latency series (names carry the
+            # k stamp, so square sizes never cross-compare)
+            for mk, mv in sorted(val.items()):
+                if (
+                    "_ms_k" in mk
+                    and isinstance(mv, (int, float))
+                    and not isinstance(mv, bool)
+                ):
+                    yield f"critpath.{mk}", float(mv), False
         elif key == "multichip" and isinstance(val, dict):
             # platform AND mesh factoring in the name: the same k on a
             # different chip count is a different series (a 1x4 round

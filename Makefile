@@ -7,7 +7,7 @@ PY ?= python
 # passes --format through; exit codes are unchanged either way
 LINT_FORMAT ?=
 
-.PHONY: lint lockwatch test chaos trace-smoke profile-smoke incident-smoke critpath-smoke multichip-smoke das-smoke swarm-smoke device-resident-smoke mesh-live t1-budget bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
+.PHONY: lint lockwatch test chaos trace-smoke profile-smoke incident-smoke critpath-smoke multichip-smoke das-smoke swarm-smoke ingress-smoke device-resident-smoke mesh-live t1-budget bench-check native native-sanitize native-sanitize-tsan native-sanitize-asan bench
 
 ## celint: concurrency & determinism static analysis (exit 1 on findings)
 lint:
@@ -104,6 +104,18 @@ das-smoke:
 ## the same assertions via tests/test_swarm_smoke.py)
 swarm-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/swarm_smoke.py
+
+## batched tx-admission boot gate: a gossip TxPush flood (with a forged
+## signature and a garbage blob buried mid-stream) drains through
+## check_txs_batch on a live node — one verify_batch pass per chunk,
+## replay admits nothing, block production takes the signer-grouped
+## parallel FilterTxs leg and keeps every admitted tx, BroadcastBatch
+## admits a follow-up batch over the wire, ingress.batch/ante.parallel
+## spans land in the tracer and the celestia_tpu_ingress_* counters
+## ride a parse-valid exposition (tier-1 runs the same assertions via
+## tests/test_ingress_smoke.py)
+ingress-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/ingress_smoke.py
 
 ## device-resident plane boot gate: one blob block prepared, processed
 ## and DAS-served with the plane FORCED on — the committed block is

@@ -1417,30 +1417,152 @@ def _host_repair_ms(k: int):
     return float(np.median(times))
 
 
-def _glv_us_per_sig(n: int = 256):
+def _glv_us_per_sig(n: int = 256, precomp=None):
     """Native batched ECDSA verify, µs per signature (ADR-011 host leg) —
     8 distinct senders so the pubkey-decompression cache behaves like a
     proposal (senders repeat).  Raises when the native kernel is absent:
     verify_batch would silently fall back to pure Python there, and that
-    figure must never be recorded under the GLV key."""
+    figure must never be recorded under the GLV key.
+
+    precomp routes the table strategy (native.ecmul_double_glv_batch):
+    False = legacy Jacobian-table symbol, True = the batched
+    precomputed-affine-table symbol, None = production auto-routing."""
     from celestia_tpu.utils import native
     from celestia_tpu.utils.secp256k1 import PrivateKey, verify_batch
 
     if not (native.available() and native.has_glv()):
         raise RuntimeError("native GLV kernel unavailable")
+    if precomp and not native.has_glv_pre():
+        raise RuntimeError("native GLV precomp symbol unavailable")
 
     keys = [PrivateKey.from_seed(b"bench-glv-%d" % (i % 8)) for i in range(n)]
     msgs = [b"bench-glv-msg-%d" % i for i in range(n)]
     sigs = [key.sign(m) for key, m in zip(keys, msgs)]
     pubs = [key.public_key().compressed() for key in keys]
-    out = verify_batch(msgs, sigs, pubs)  # warm
+    out = verify_batch(msgs, sigs, pubs, precomp=precomp)  # warm
     times = []
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.time()
-        out = verify_batch(msgs, sigs, pubs)
+        out = verify_batch(msgs, sigs, pubs, precomp=precomp)
         times.append((time.time() - t0) * 1e6 / n)
     assert all(out), "bench GLV verify failed on valid signatures"
     return float(np.median(times))
+
+
+def _tx_ingress_extras(n: int = 512) -> dict:
+    """extras.tx_ingress: the batched admission plane end to end.
+
+    Sustained CheckTx tx/s at batch {1, 64, 512} in the cold regime
+    (empty caches — first sight of the bytes) and at batch 512 in the
+    warm regime (a twin node re-admitting bytes whose signature/decode
+    verdicts are already cached: the gossip-replay shape).  Then the
+    FilterTxs pair the acceptance criterion names: the sequential
+    cold leg (the r05 ``filter_512_pfb_ms`` regime) vs the batched
+    plane (admission through check_txs_batch pre-pays signatures and
+    decodes, filter runs admission-warmed), with the kept-tx lists
+    asserted BYTE-IDENTICAL in-leg.  Finally GLV µs/sig with and
+    without the precomputed-table symbol.  All figures are batch- and
+    regime-stamped for tools/bench_check.py (tx/s and speedup series
+    are higher-is-better)."""
+    from celestia_tpu.da import inclusion
+    from celestia_tpu.node.testnode import TestNode
+    from celestia_tpu.utils.secp256k1 import PrivateKey
+
+    out = {}
+    node, txs = _make_pfb_node_and_txs(n, 2000, 6, 128, b"ingress")
+    app = node.app
+
+    def _twin():
+        # fresh node with the IDENTICAL genesis (same seeds/accounts), so
+        # the one signed tx set stays valid and each drain starts from a
+        # clean check state
+        keys = [PrivateKey.from_seed(b"ingress-%d" % i) for i in range(8)]
+        t = TestNode(
+            funded_accounts=[(key, 10**15) for key in keys],
+            auto_produce=False,
+        )
+        t.app.params.set("blob", "GovMaxSquareSize", 128)
+        return t
+
+    def _clear(a):
+        inclusion._COMMITMENT_CACHE.clear()
+        a._sig_cache.clear()
+        a._decoded_cache.clear()
+
+    # -- sequential FilterTxs, cold (the r05 baseline regime) ----------
+    seq_times = []
+    for _ in range(3):
+        _clear(app)
+        t0 = time.time()
+        kept_seq = app._filter_txs(txs, parallel=False)
+        seq_times.append((time.time() - t0) * 1000.0)
+    assert len(kept_seq) == n, f"filter kept {len(kept_seq)}/{n}"
+    out["filter_seq_cold_512_ms"] = round(float(np.median(seq_times)), 1)
+
+    # -- sustained CheckTx tx/s, cold, batch {1, 64, 512} --------------
+    for batch in (1, 64, 512):
+        tnode = _twin()
+        _clear(tnode.app)
+        t0 = time.time()
+        if batch == 1:
+            results = [tnode.app.check_tx(raw) for raw in txs]
+        else:
+            results = []
+            for i in range(0, n, batch):
+                results.extend(tnode.app.check_txs_batch(txs[i : i + batch]))
+        wall = time.time() - t0
+        assert [r.code for r in results] == [0] * n, "bench admission failed"
+        out[f"check_b{batch}_cold_tx_per_s"] = round(n / wall, 1)
+        if batch == 64:
+            # in-leg verdict identity: the batched drain must match a
+            # per-tx CheckTx loop result-for-result
+            loop_node = _twin()
+            _clear(loop_node.app)
+            loop = [loop_node.app.check_tx(raw) for raw in txs]
+            assert [(r.code, r.log) for r in loop] == [
+                (r.code, r.log) for r in results
+            ], "batched CheckTx verdicts diverged from the sequential loop"
+        if batch == 512:
+            warmed_sig, warmed_dec = tnode.app._sig_cache, tnode.app._decoded_cache
+    # warm regime: a twin re-admits the same bytes with the verdict
+    # caches attached (gossip replay / node restart shape)
+    wnode = _twin()
+    wnode.app._sig_cache = warmed_sig
+    wnode.app._decoded_cache = warmed_dec
+    t0 = time.time()
+    results = wnode.app.check_txs_batch(txs)
+    wall = time.time() - t0
+    assert [r.code for r in results] == [0] * n
+    out["check_b512_warm_tx_per_s"] = round(n / wall, 1)
+
+    # -- the batched admission plane's FilterTxs ----------------------
+    # production path: every proposal tx arrived through CheckTx, which
+    # pre-paid its signature + decode verdicts; filter then runs
+    # admission-warmed (and through the parallel leg on multi-core
+    # hosts).  Verdict identity with the cold sequential leg is the
+    # acceptance assert.
+    bnode = _twin()
+    _clear(bnode.app)
+    bnode.app.check_txs_batch(txs)  # admission warms the plane
+    bat_times = []
+    for _ in range(3):
+        t0 = time.time()
+        kept_bat = bnode.app._filter_txs(txs)
+        bat_times.append((time.time() - t0) * 1000.0)
+    assert kept_bat == kept_seq, "batched-plane filter verdicts diverged"
+    out["filter_batched_512_ms"] = round(float(np.median(bat_times)), 1)
+    out["filter_512_speedup"] = round(
+        out["filter_seq_cold_512_ms"] / max(out["filter_batched_512_ms"], 1e-3),
+        2,
+    )
+
+    # -- GLV µs/sig with and without table precomputation -------------
+    try:
+        out["glv_nopre_us_per_sig"] = round(_glv_us_per_sig(precomp=False), 1)
+        out["glv_pre_us_per_sig"] = round(_glv_us_per_sig(precomp=True), 1)
+    except Exception as e:
+        out["glv_pre_error"] = repr(e)[:200]
+    return out
 
 
 def _dah_128_fixture_match() -> bool:
@@ -1496,6 +1618,10 @@ def _host_only_main():
         extras["filter_512_pfb_ms"] = round(_filter_txs_ms(512), 1)
     except Exception as e:
         extras["filter_error"] = repr(e)[:200]
+    try:
+        extras["tx_ingress"] = _tx_ingress_extras()
+    except Exception as e:
+        extras["tx_ingress_error"] = repr(e)[:200]
     try:
         extras["glv_us_per_sig"] = round(_glv_us_per_sig(), 1)
     except Exception as e:
@@ -1714,6 +1840,10 @@ def main():
         extras["filter_512_pfb_ms"] = round(_filter_txs_ms(512), 1)
     except Exception as e:
         extras["filter_error"] = repr(e)[:200]
+    try:
+        extras["tx_ingress"] = _tx_ingress_extras()
+    except Exception as e:
+        extras["tx_ingress_error"] = repr(e)[:200]
     try:
         batch_ms = _amortized_device_ms(k, batch=BATCH)
         extras[f"batch{BATCH}x{k}_per_square_ms"] = round(batch_ms / BATCH, 3)

@@ -516,20 +516,39 @@ class GossipEngine:
         return want
 
     def on_tx_push(self, raws: List[bytes]) -> int:
-        """Admit pushed txs through CheckTx; re-announce admitted ones.
-        A failed admission is NOT marked seen: it may succeed later
-        (sequence gaps), and the periodic re-announce retries it."""
-        admitted = 0
+        """Admit pushed txs through the batched CheckTx plane; re-announce
+        admitted ones.  The whole pending push drains through ONE
+        ``broadcast_txs_batch`` call (single verify_batch pass over all
+        fresh signatures) instead of looping per-tx CheckTx.  A failed
+        admission is NOT marked seen: it may succeed later (sequence
+        gaps), and the periodic re-announce retries it."""
+        fresh: List[bytes] = []
+        fresh_hashes: List[bytes] = []
         for raw in raws:
             h = hashlib.sha256(raw).digest()
             if h in self._seen_tx:
                 continue
-            try:
-                res = self.node.broadcast_tx(raw)
-            except Exception as e:
-                faults.note("gossip.txpush", e)
-                continue
-            if res.code == 0:
+            fresh.append(raw)
+            fresh_hashes.append(h)
+        if not fresh:
+            return 0
+        admitted = 0
+        try:
+            results = self.node.broadcast_txs_batch(fresh)
+        except Exception as e:
+            # batch-layer failure (not a per-tx verdict): note it and
+            # degrade to the per-tx loop so one poisoned raw cannot
+            # starve its neighbors
+            faults.note("gossip.txpush", e)
+            results = []
+            for raw in fresh:
+                try:
+                    results.append(self.node.broadcast_tx(raw))
+                except Exception as e:  # noqa: PERF203 - per-tx isolation
+                    faults.note("gossip.txpush", e)
+                    results.append(None)
+        for h, res in zip(fresh_hashes, results):
+            if res is not None and res.code == 0:
                 self._seen_tx.add(h)
                 admitted += 1
         return admitted

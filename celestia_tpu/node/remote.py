@@ -162,6 +162,17 @@ class RemoteNode:
             out["code"], out["log"], bytes.fromhex(out["txhash"])
         )
 
+    def broadcast_txs_batch(self, raws) -> list:
+        """Batched submission through the server's BroadcastBatch RPC:
+        one check_txs_batch pass node-side, per-tx results in order."""
+        out = self._call_json(
+            "BroadcastBatch", {"txs": [r.hex() for r in raws]}
+        )
+        return [
+            SubmitResult(e["code"], e["log"], bytes.fromhex(e["txhash"]))
+            for e in out["results"]
+        ]
+
     def get_tx(self, tx_hash: bytes) -> Optional[dict]:
         try:
             out = self._call_json("GetTx", {"hash": tx_hash.hex()})

@@ -9,6 +9,10 @@ bytes for broadcast.
 
 Methods (service ``celestia.tpu.v1.Node``):
   Broadcast    raw BlobTx/Tx bytes        -> {code, log, txhash}
+  BroadcastBatch {"txs": [hex, ...]}      -> {"results": [{code, log,
+               txhash}, ...]}: batched admission — one check_txs_batch
+               pass (single verify_batch over fresh signatures) under
+               one service-lock hold
   GetTx        {"hash": hex}              -> tx status or {"found": false}
   AccountInfo  {"address": hex}           -> {account_number, sequence}
   Simulate     raw tx bytes               -> {gas} | {code, log}
@@ -419,6 +423,22 @@ class NodeService:
         res = self.node.broadcast_tx(raw)
         return json.dumps(
             {"code": res.code, "log": res.log, "txhash": res.tx_hash.hex()}
+        ).encode()
+
+    def broadcast_batch(self, req: bytes, ctx) -> bytes:
+        """Batched tx submission: the whole chunk drains through ONE
+        check_txs_batch pass (single verify_batch over fresh signatures)
+        under one service-lock hold; per-tx results in input order."""
+        d = json.loads(req)
+        raws = [bytes.fromhex(r) for r in d.get("txs", [])]
+        results = self.node.broadcast_txs_batch(raws)
+        return json.dumps(
+            {
+                "results": [
+                    {"code": r.code, "log": r.log, "txhash": r.tx_hash.hex()}
+                    for r in results
+                ]
+            }
         ).encode()
 
     def get_tx(self, req: bytes, ctx) -> bytes:
@@ -1437,15 +1457,25 @@ class NodeService:
         raws = [bytes.fromhex(r) for r in d.get("txs", [])]
         if eng is not None:
             n = eng.on_tx_push(raws)
+        elif raws:
+            # no gossip engine: drain the push through the batched
+            # admission plane directly (one verify_batch pass), degrading
+            # to the per-tx loop on a batch-layer failure
+            try:
+                results = self.node.broadcast_txs_batch(raws)
+                n = sum(1 for r in results if r.code == 0)
+            except Exception as e:
+                faults.note("server.txpush", e)
+                n = 0
+                for raw in raws:
+                    try:
+                        if self.node.broadcast_tx(raw).code == 0:
+                            n += 1
+                    except Exception as e:  # noqa: PERF203 - per-tx isolation
+                        faults.note("server.txpush", e)
+                        continue
         else:
             n = 0
-            for raw in raws:
-                try:
-                    if self.node.broadcast_tx(raw).code == 0:
-                        n += 1
-                except Exception as e:
-                    faults.note("server.txpush", e)
-                    continue
         return json.dumps({"admitted": n}).encode()
 
     # -- grpc wiring ---------------------------------------------------
@@ -1453,6 +1483,7 @@ class NodeService:
     def handlers(self) -> grpc.GenericRpcHandler:
         rpcs = {
             "Broadcast": self.broadcast,
+            "BroadcastBatch": self.broadcast_batch,
             "GetTx": self.get_tx,
             "AccountInfo": self.account_info,
             "Simulate": self.simulate,

@@ -676,6 +676,9 @@ class TestNode:
 
     def _broadcast_tx_locked(self, raw: bytes) -> SubmitResult:
         res = self.app.check_tx(raw)
+        return self._admit_checked_locked(raw, res)
+
+    def _admit_checked_locked(self, raw: bytes, res) -> SubmitResult:
         tx_hash = hashlib.sha256(raw).digest()
         if res.code != 0:
             return SubmitResult(res.code, res.log, tx_hash)
@@ -683,6 +686,25 @@ class TestNode:
         tx = unmarshal_tx(btx.tx if btx is not None else raw)
         self.mempool.add(raw, tx.fee.gas_price(), self.height)
         return SubmitResult(0, "", tx_hash)
+
+    def broadcast_txs_batch(self, raws: List[bytes]) -> List[SubmitResult]:
+        """Batched BroadcastMode_SYNC: one service-lock hold, one
+        ``check_txs_batch`` pass (single verify_batch over all fresh
+        single-key signatures), then mempool admission per admitted tx.
+        Results are positionally identical to looping broadcast_tx."""
+        with self._service_lock:
+            results = self.app.check_txs_batch(list(raws))
+            out: List[SubmitResult] = []
+            for raw, res in zip(raws, results):
+                try:
+                    out.append(self._admit_checked_locked(raw, res))
+                except ValueError as e:
+                    # mempool admission error (e.g. oversize): isolate it
+                    # per tx — the rest of the drained queue still lands
+                    out.append(
+                        SubmitResult(1, str(e), hashlib.sha256(raw).digest())
+                    )
+            return out
 
     def get_tx(self, tx_hash: bytes) -> Optional[dict]:
         with self._service_lock:

@@ -101,6 +101,28 @@ def flat_msgs(tx: Tx):
     return flat
 
 
+def ante_footprint(tx: Tx) -> Optional[tuple]:
+    """The account addresses whose state the ante chain reads or writes
+    for ``tx``: the signer (pubkey/sequence/account-number checks,
+    sequence increment, fee payment, vesting-lock reads) and the fee
+    granter when set (allowance read + use_grant write).  Params are
+    read-only for every tx and FEE_COLLECTOR is credited but never read
+    by any verdict, so two txs with disjoint footprints produce the same
+    keep/drop verdicts in any interleaving — the independence argument
+    the parallel FilterTxs grouping rests on (specs/tx_ingress.md).
+
+    Returns None when the footprint cannot be determined (malformed
+    pubkey): callers must treat such a tx as overlapping everything.
+    """
+    try:
+        addrs = [tx.signer_address()]
+    except ValueError:
+        return None
+    if tx.fee_granter:
+        addrs.append(bytes(tx.fee_granter))
+    return tuple(addrs)
+
+
 # --- decorators -------------------------------------------------------------
 
 

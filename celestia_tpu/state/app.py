@@ -322,6 +322,7 @@ class App:
 
     def check_tx(self, raw: bytes, is_recheck: bool = False) -> TxResult:
         self.telemetry.incr("check_tx")
+        key = _hashlib.sha256(raw).digest()
         btx = unmarshal_blob_tx(raw)
         # run the ante chain on a branch of the persistent check state;
         # only successful checks fold back (failed antes must not burn a
@@ -340,9 +341,7 @@ class App:
                     # Prepare/Process reuse it instead of re-hashing the
                     # blob payloads (check_tx.go validates, then the
                     # proposal paths validate the same bytes again)
-                    self._remember_decoded(
-                        _hashlib.sha256(raw).digest(), tx, btx.tx
-                    )
+                    self._remember_decoded(key, tx, btx.tx)
                 raw_inner = btx.tx
             else:
                 tx = unmarshal_tx(raw)
@@ -353,6 +352,15 @@ class App:
                     # — including authz-wrapped PFBs
                     return TxResult(1, "MsgPayForBlobs transaction missing blobs", 0, 0)
                 raw_inner = raw
+            # signature cache, both directions: a recheck / re-submission
+            # of exact bytes this node already verified skips the EC
+            # multiplication, and a fresh admission remembers its verdict
+            # so the prepare/process legs hit (the cache key commits to
+            # the FULL raw bytes, so a hit proves the same check)
+            sig_ok = None
+            if not tx.is_multisig() and self._sig_cache.get(key) is not None:
+                sig_ok = True
+                self.telemetry.incr("ingress_sig_cache_hit")
             ctx = AnteContext(
                 tx=tx,
                 raw_tx=raw_inner,
@@ -364,16 +372,162 @@ class App:
                 is_check_tx=True,
                 is_recheck=is_recheck,
                 min_gas_price=self.min_gas_price,
+                sig_ok=sig_ok,
                 height=self.next_height(),
                 feegrant=FeeGrantKeeper(branch.store("feegrant")),
                 time_ns=self.block_time_ns,
             )
             meter = run_ante(ctx)
             check_state.write_back(branch)
+            if sig_ok is None and not tx.is_multisig():
+                # ante succeeded => verify_signature verified these exact
+                # bytes inline; admission now pre-pays the proposal legs
+                self._remember_sig(key)
             return TxResult(0, "", tx.fee.gas_limit, meter.consumed)
         except (AnteError, ValueError) as e:
             self.telemetry.incr("check_tx_rejected")
             return TxResult(1, str(e), 0, 0)
+
+    def check_txs_batch(
+        self, raws: List[bytes], is_recheck: bool = False
+    ) -> List[TxResult]:
+        """Batched CheckTx: decode a chunk of mempool ingress, resolve
+        every single-key signature in ONE threaded ``verify_batch`` pass,
+        then run the ante chain per tx with the verdict pre-resolved.
+
+        Reuses the ``_decode_proposal_txs`` discipline: decoded-tx cache
+        probe by tx-bytes hash, batch commitment warming, a per-call
+        ``batch_ok`` map immune to mid-call LRU eviction, sig-cache
+        probes resolving to True, multisig falling back to inline
+        verification inside the ante chain.  Dedupe is SIG-LEVEL only:
+        ante still runs once per input IN ORDER against the shared check
+        state, so a duplicated raw fails its second occurrence with the
+        same sequence mismatch the sequential loop produces — results
+        are positionally identical to ``[check_tx(r) for r in raws]``
+        (pinned by tests/test_tx_ingress.py).
+        """
+        from celestia_tpu.state.ante import flat_msgs
+        from celestia_tpu.utils.secp256k1 import verify_batch
+
+        n = len(raws)
+        self.telemetry.incr("check_tx", n)
+        self.telemetry.incr("ingress_batch_calls")
+        self.telemetry.incr("ingress_batch_txs", n)
+        with tracing.span("ingress.batch", txs=n):
+            # decode phase: check_tx semantics + decoded-cache probe,
+            # with every fresh blob commitment warmed in one native call
+            keys: List[bytes] = []
+            parsed: List[tuple] = []  # (raw, key, btx_or_None, cache_hit)
+            warm: List = []
+            for raw in raws:
+                key = _hashlib.sha256(raw).digest()
+                keys.append(key)
+                hit = self._decoded_cache.get(key)
+                if hit is not None:
+                    parsed.append((raw, key, None, hit))
+                    continue
+                btx = unmarshal_blob_tx(raw)
+                if btx is not None and not is_recheck:
+                    warm.extend(btx.blobs)
+                parsed.append((raw, key, btx, None))
+            if warm:
+                from celestia_tpu.da.inclusion import warm_commitments
+
+                warm_commitments(warm)
+            decoded: List[tuple] = []  # (tx, raw_inner, err)
+            for raw, key, btx, hit in parsed:
+                if hit is not None:
+                    decoded.append((hit[0], hit[1], None))
+                    continue
+                try:
+                    if btx is not None:
+                        if is_recheck:
+                            tx = unmarshal_tx(btx.tx)
+                        else:
+                            tx = validate_blob_tx(btx, self.chain_id)
+                            self._remember_decoded(key, tx, btx.tx)
+                        raw_inner = btx.tx
+                    else:
+                        tx = unmarshal_tx(raw)
+                        if any(
+                            isinstance(m, MsgPayForBlobs)
+                            for m in flat_msgs(tx)
+                        ):
+                            raise AnteError(
+                                "MsgPayForBlobs transaction missing blobs"
+                            )
+                        raw_inner = raw
+                    decoded.append((tx, raw_inner, None))
+                except (AnteError, ValueError) as e:
+                    decoded.append((None, None, e))
+            # signature phase: batch_ok is THIS call's key -> verdict map
+            # (cache hits resolve True, distinct fresh keys verify once,
+            # output reads ONLY batch_ok — immune to LRU eviction)
+            batch_ok: Dict[bytes, Optional[bool]] = {}
+            live: List = []
+            live_keys: List[bytes] = []
+            for (tx, _raw_inner, err), key in zip(decoded, keys):
+                if tx is None or tx.is_multisig() or key in batch_ok:
+                    continue
+                if self._sig_cache.get(key) is not None:
+                    batch_ok[key] = True
+                    self.telemetry.incr("ingress_sig_cache_hit")
+                else:
+                    batch_ok[key] = None
+                    live.append(tx)
+                    live_keys.append(key)
+            if live:
+                sig_results = verify_batch(
+                    [tx.sign_bytes(self.chain_id) for tx in live],
+                    [tx.signature for tx in live],
+                    [tx.pubkey for tx in live],
+                )
+                self.telemetry.incr("ingress_batch_verified", len(live))
+                for key, ok in zip(live_keys, sig_results):
+                    batch_ok[key] = bool(ok)
+                    if ok:
+                        self._remember_sig(key)
+            # ante phase: sequential, order-preserving, on the shared
+            # check state (only successful checks fold back)
+            check_state = self._get_check_state()
+            results: List[TxResult] = []
+            for raw, (tx, raw_inner, err), key in zip(raws, decoded, keys):
+                if err is not None:
+                    self.telemetry.incr("check_tx_rejected")
+                    results.append(TxResult(1, str(err), 0, 0))
+                    continue
+                if tx.is_multisig():
+                    sig_ok: Optional[bool] = None
+                    self.telemetry.incr("ingress_multisig_inline")
+                else:
+                    sig_ok = batch_ok[key]
+                branch = check_state.branch()
+                try:
+                    ctx = AnteContext(
+                        tx=tx,
+                        raw_tx=raw_inner,
+                        accounts=AccountKeeper(branch.store("auth")),
+                        bank=BankKeeper(branch.store("bank")),
+                        params=ParamsKeeper(branch.store("params")),
+                        chain_id=self.chain_id,
+                        app_version=self.app_version,
+                        is_check_tx=True,
+                        is_recheck=is_recheck,
+                        min_gas_price=self.min_gas_price,
+                        sig_ok=sig_ok,
+                        height=self.next_height(),
+                        feegrant=FeeGrantKeeper(branch.store("feegrant")),
+                        time_ns=self.block_time_ns,
+                    )
+                    meter = run_ante(ctx)
+                    check_state.write_back(branch)
+                    results.append(
+                        TxResult(0, "", tx.fee.gas_limit, meter.consumed)
+                    )
+                except (AnteError, ValueError) as e:
+                    self.telemetry.incr("check_tx_rejected")
+                    results.append(TxResult(1, str(e), 0, 0))
+            return results
 
     # ------------------------------------------------------------------
     # PrepareProposal — prepare_proposal.go:23-96
@@ -393,8 +547,9 @@ class App:
         rounds, skip the EC multiplications — the dominant per-block
         host cost.  Only a verifying (pubkey, sign_bytes, signature)
         triple derived from the EXACT raw bytes is ever cached, so a hit
-        proves the same signature check.  (CheckTx verifies inline in
-        the ante chain and does not populate this cache.)
+        proves the same signature check.  (CheckTx and check_txs_batch
+        populate the same cache on successful admission, so a proposal
+        built from batched mempool ingress filters signature-warm.)
         """
         from celestia_tpu.utils.secp256k1 import verify_batch
 
@@ -513,15 +668,50 @@ class App:
     def _decoded_cache_max(self, n: int) -> None:
         self._decoded_cache.set_max_entries(n)
 
-    def _filter_txs(self, txs: List[bytes]) -> List[bytes]:
+    # below this many proposal txs the signer-grouping + fold overhead
+    # outweighs any parallel ante win; the sequential leg is already fast
+    _FILTER_PARALLEL_MIN_TXS = 16
+
+    def _filter_txs(
+        self, txs: List[bytes], parallel: Optional[bool] = None
+    ) -> List[bytes]:
         """FilterTxs parity (validate_txs.go:29-97): run the ante chain over
-        each tx on one branched state, in priority order; drop failures."""
+        each tx on one branched state, in priority order; drop failures.
+
+        ``parallel`` — None auto-routes (multi-core host AND enough txs),
+        True/False force a leg.  The parallel leg groups txs by ante
+        footprint and runs independent groups through the hostpool; it
+        degrades to the sequential leg on any hazard (see
+        ``_filter_groups``) and is pinned byte-identical to it by
+        tests/test_tx_ingress.py.
+        """
+        decoded = self._decode_proposal_txs(txs)
+        if parallel is None:
+            from celestia_tpu.utils import hostpool
+
+            parallel = (
+                hostpool.cpu_threads() > 1
+                and len(decoded) >= self._FILTER_PARALLEL_MIN_TXS
+            )
+        if parallel:
+            kept = self._filter_txs_parallel(decoded)
+            if kept is not None:
+                return kept
+            self.telemetry.incr("ingress_parallel_fallback")
+        return self._filter_txs_sequential(decoded)
+
+    def _filter_txs_sequential(self, decoded: List[tuple]) -> List[bytes]:
+        """The reference leg: one shared branch, shared keepers, txs in
+        priority order.  NOTE a failed ante leaves its partial writes on
+        the shared branch (fee already deducted before the failing
+        decorator ran) — later txs from the same payer observe them; the
+        parallel leg reproduces this exactly."""
         branch = self.store.branch()
         accounts = AccountKeeper(branch.store("auth"))
         bank = BankKeeper(branch.store("bank"))
         params = ParamsKeeper(branch.store("params"))
         kept: List[bytes] = []
-        for raw, tx, raw_inner, sig_ok, err in self._decode_proposal_txs(txs):
+        for raw, tx, raw_inner, sig_ok, err in decoded:
             if err is not None:
                 self.telemetry.incr("prepare_proposal_dropped_tx")
                 continue
@@ -544,6 +734,143 @@ class App:
             except (AnteError, ValueError):
                 self.telemetry.incr("prepare_proposal_dropped_tx")
                 continue
+        return kept
+
+    def _filter_groups(self, decoded: List[tuple]) -> Optional[List[List[int]]]:
+        """Union-find over ante footprints -> independent groups of decoded
+        indices, or None when a hazard forces the sequential leg.
+
+        The ante chain reads/writes ONLY the tx's footprint accounts
+        (signer + fee granter: auth record, bank balance, feegrant key),
+        reads params (read-only here), and credits FEE_COLLECTOR (never
+        read by any verdict).  Hazards — cases where that independence
+        argument does not hold — degrade to sequential:
+
+        * footprint undeterminable (malformed pubkey);
+        * a footprint account that does not exist yet: get_or_create
+          would allocate from the GLOBAL account-number counter, a
+          cross-group write;
+        * a footprint naming FEE_COLLECTOR: its balance would then gate
+          a verdict.
+        """
+        from celestia_tpu.state.ante import ante_footprint
+
+        parent: Dict[bytes, bytes] = {}
+
+        def find(a: bytes) -> bytes:
+            while parent[a] != a:
+                parent[a] = parent[parent[a]]
+                a = parent[a]
+            return a
+
+        tx_root: List[Optional[bytes]] = [None] * len(decoded)
+        for i, (_raw, tx, _raw_inner, _sig_ok, err) in enumerate(decoded):
+            if err is not None:
+                continue  # pure drop: touches no state, needs no group
+            fp = ante_footprint(tx)
+            if fp is None:
+                return None
+            for addr in fp:
+                if addr == FEE_COLLECTOR:
+                    return None
+                if addr not in parent:
+                    parent[addr] = addr
+                    if self.accounts.get(addr) is None:
+                        return None
+            ra = find(fp[0])
+            for addr in fp[1:]:
+                rb = find(addr)
+                if ra != rb:
+                    parent[rb] = ra
+            tx_root[i] = ra
+        groups: Dict[bytes, List[int]] = {}
+        for i, a in enumerate(tx_root):
+            if a is None:
+                continue
+            groups.setdefault(find(a), []).append(i)
+        return list(groups.values())
+
+    def _filter_txs_parallel(
+        self, decoded: List[tuple]
+    ) -> Optional[List[bytes]]:
+        """Hostpool-parallel FilterTxs: ante for independent-footprint
+        groups runs concurrently against branch snapshots; verdicts are
+        then replayed in a deterministic sequential fold that performs
+        the actual write-backs in original priority order.  Returns None
+        to degrade (grouping hazard, or a pool-layer failure)."""
+        from celestia_tpu.utils import faults, hostpool
+
+        groups = self._filter_groups(decoded)
+        if groups is None or len(groups) <= 1:
+            return None
+        base = self.store.branch()
+        height = self.next_height()
+
+        def ante_group(idxs: List[int]) -> List[tuple]:
+            # re-runnable after a WorkerDeath self-heal: every mutation is
+            # confined to branches created INSIDE this call
+            gbranch = base.branch()
+            out = []
+            for i in idxs:
+                _raw, tx, raw_inner, sig_ok, _err = decoded[i]
+                sub = gbranch.branch()
+                ok = True
+                try:
+                    ctx = AnteContext(
+                        tx=tx,
+                        raw_tx=raw_inner,
+                        accounts=AccountKeeper(sub.store("auth")),
+                        bank=BankKeeper(sub.store("bank")),
+                        params=ParamsKeeper(sub.store("params")),
+                        chain_id=self.chain_id,
+                        app_version=self.app_version,
+                        sig_ok=sig_ok,
+                        height=height,
+                        feegrant=FeeGrantKeeper(sub.store("feegrant")),
+                        time_ns=self.block_time_ns,
+                    )
+                    run_ante(ctx)
+                except (AnteError, ValueError):
+                    ok = False
+                # fold the sub-branch back on failure TOO: the sequential
+                # leg's shared keepers keep a failed ante's partial writes
+                # (fee deducted before the failing decorator), and later
+                # same-payer txs must observe them
+                delta = sub.overlay_delta()
+                gbranch.write_back(sub)
+                out.append((i, ok, delta))
+            return out
+
+        with tracing.span(
+            "ante.parallel",
+            groups=len(groups),
+            txs=sum(len(g) for g in groups),
+        ):
+            try:
+                results = hostpool.run_sharded(ante_group, groups)
+            except Exception as e:  # pool-layer failure: degrade, don't drop
+                faults.note("ingress.parallel", e)
+                return None
+        verdicts: Dict[int, tuple] = {}
+        for group_out in results:
+            for i, ok, delta in group_out:
+                verdicts[i] = (ok, delta)
+        # deterministic sequential fold: write-backs in priority order on
+        # ONE branch (discarded like the sequential leg's), kept list and
+        # drop counters in original order
+        fold = self.store.branch()
+        kept: List[bytes] = []
+        for i, (raw, tx, _raw_inner, _sig_ok, err) in enumerate(decoded):
+            if err is not None or i not in verdicts:
+                self.telemetry.incr("prepare_proposal_dropped_tx")
+                continue
+            ok, delta = verdicts[i]
+            fold.apply_overlay_delta(delta)
+            if ok:
+                kept.append(raw)
+            else:
+                self.telemetry.incr("prepare_proposal_dropped_tx")
+        self.telemetry.incr("ingress_parallel_groups", len(groups))
         return kept
 
     def _extend_block_cached(

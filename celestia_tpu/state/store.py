@@ -230,6 +230,32 @@ class MultiStore:
         for layer in branched._layers.values():
             layer.apply_to_parent()
 
+    def overlay_delta(self) -> Dict[str, Tuple[Dict[bytes, bytes], Set[bytes]]]:
+        """Snapshot of this BRANCH's pending writes: {store: (writes,
+        deletes)} for every substore the branch touched.  The captured
+        per-tx delta is what the parallel FilterTxs fold replays
+        sequentially in priority order (state/app.py) — capture happens
+        before write_back, which clears the overlay."""
+        if self._parent is None:
+            raise ValueError("overlay_delta: not a branched store")
+        out: Dict[str, Tuple[Dict[bytes, bytes], Set[bytes]]] = {}
+        for name, layer in self._layers.items():
+            if layer.writes or layer.deletes:
+                out[name] = (dict(layer.writes), set(layer.deletes))
+        return out
+
+    def apply_overlay_delta(
+        self, delta: Dict[str, Tuple[Dict[bytes, bytes], Set[bytes]]]
+    ) -> None:
+        """Replay a captured overlay delta through this store's views
+        (writes first, then deletes — apply_to_parent order)."""
+        for name, (writes, deletes) in delta.items():
+            st = self.store(name)
+            for k, v in writes.items():
+                st.set(k, v)
+            for k in deletes:
+                st.delete(k)
+
     # --- merkle sync ------------------------------------------------------
 
     def _sync_smt(self) -> Dict[str, bytes]:

@@ -829,9 +829,17 @@ class Tx:
         return pk.verify(msg, self.signature)
 
     def signer_address(self) -> bytes:
-        if self.is_multisig():
-            return MultisigPubKey.unmarshal(self.pubkey).address()
-        return PublicKey.from_compressed(self.pubkey).address()
+        # memoized: the ante chain derives the signer several times per
+        # tx and decoded txs are cached across admission/filter passes
+        # (idempotent, so benign under concurrent first calls)
+        memo = self.__dict__.get("_signer_addr")
+        if memo is None:
+            if self.is_multisig():
+                memo = MultisigPubKey.unmarshal(self.pubkey).address()
+            else:
+                memo = PublicKey.from_compressed(self.pubkey).address()
+            object.__setattr__(self, "_signer_addr", memo)
+        return memo
 
     def marshal(self) -> bytes:
         out = bytearray()

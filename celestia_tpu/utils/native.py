@@ -34,6 +34,7 @@ _SO = (
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 _has_glv = False
+_has_glv_pre = False
 
 # One-way degradation pin (specs/robustness.md "degradation ladder"): a
 # native fault mid-run poisons the library for the REST OF THE PROCESS,
@@ -176,7 +177,7 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.secp256k1_ecmul_double_batch.argtypes = [
         u8p, u8p, u8p, ctypes.c_int, u8p, u8p, ctypes.c_int,
     ]
-    global _has_glv
+    global _has_glv, _has_glv_pre
     try:
         lib.secp256k1_ecmul_double_glv_batch.argtypes = [
             u8p, u8p, u8p, ctypes.c_int, u8p, u8p, ctypes.c_int,
@@ -185,6 +186,15 @@ def _load() -> Optional[ctypes.CDLL]:
     except AttributeError:
         # stale .so without the GLV symbol: degrade to the plain path
         _has_glv = False
+    try:
+        lib.secp256k1_ecmul_double_glv_batch_pre.argtypes = [
+            u8p, u8p, u8p, ctypes.c_int, u8p, u8p, ctypes.c_int,
+        ]
+        _has_glv_pre = True
+    except AttributeError:
+        # stale .so without the precomputed-table symbol: the legacy GLV
+        # batch still works, ingress just loses the per-batch amortization
+        _has_glv_pre = False
     _lib = lib
     return _lib
 
@@ -543,9 +553,19 @@ def has_glv() -> bool:
     return _load() is not None and _has_glv
 
 
+def has_glv_pre() -> bool:
+    return _load() is not None and _has_glv_pre
+
+
+# below this many live verifies the _pre symbol's per-stripe table
+# normalization costs more than the mixed-affine digit loop saves
+_GLV_PRE_MIN_BATCH = 4
+
+
 def ecmul_double_glv_batch(
     ks: np.ndarray, signs: np.ndarray, pubs: np.ndarray,
     nthreads: Optional[int] = None,
+    precomp: Optional[bool] = None,
 ):
     """Threaded batch of GLV-split double multiplications.
 
@@ -554,6 +574,13 @@ def ecmul_double_glv_batch(
     signs: uint8[n, 4] (1 = negative component); pubs: uint8[n, 64]
     UNCOMPRESSED affine keys (x||y big-endian).
     Returns (ok uint8[n], x uint8[n, 32]).
+
+    precomp — route to secp256k1_ecmul_double_glv_batch_pre, which
+    normalizes every verify's Q-tables to affine with one shared
+    Montgomery inversion per stripe so the digit loops run all-mixed-
+    affine.  None = auto (use it when available and the batch is big
+    enough to amortize the table normalization); True = force when the
+    symbol exists; False = legacy Jacobian-table symbol.
     """
     lib = _load()
     if lib is None:
@@ -564,7 +591,14 @@ def ecmul_double_glv_batch(
     n = ks.shape[0]
     out_x = np.zeros((n, 32), dtype=np.uint8)
     ok = np.zeros(n, dtype=np.uint8)
-    lib.secp256k1_ecmul_double_glv_batch(
+    if precomp is None:
+        precomp = _has_glv_pre and n >= _GLV_PRE_MIN_BATCH
+    fn = (
+        lib.secp256k1_ecmul_double_glv_batch_pre
+        if (precomp and _has_glv_pre)
+        else lib.secp256k1_ecmul_double_glv_batch
+    )
+    fn(
         _ptr(ks), _ptr(signs), _ptr(pubs), n, _ptr(out_x), _ptr(ok),
         _resolve_threads(nthreads),
     )

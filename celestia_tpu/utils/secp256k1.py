@@ -370,13 +370,17 @@ def _uncompressed64(raw: bytes):
     return pk.x.to_bytes(32, "big") + pk.y.to_bytes(32, "big")
 
 
-def verify_batch(msgs, sigs, pubkeys) -> list:
+def verify_batch(msgs, sigs, pubkeys, precomp=None) -> list:
     """Verify many (msg, sig, compressed-pubkey) triples at once.
 
     Uses the threaded native batch path when available (the reference's
     analogue is per-tx C secp256k1 verification inside FilterTxs /
     ProcessProposal — app/validate_txs.go:39-97); falls back to sequential
     verify otherwise.  Returns a list of bools.
+
+    precomp routes the GLV leg's table strategy (see
+    native.ecmul_double_glv_batch): None = auto, True/False force the
+    precomputed-affine-table / legacy symbol.  Ignored off the GLV path.
     """
     import numpy as np
 
@@ -456,7 +460,9 @@ def verify_batch(msgs, sigs, pubkeys) -> list:
     # failures) would each pay the kernel's on-curve validation work
     idx = np.flatnonzero(live)
     if use_glv:
-        ok, xs = native.ecmul_double_glv_batch(ks[idx], sgn[idx], pubs[idx])
+        ok, xs = native.ecmul_double_glv_batch(
+            ks[idx], sgn[idx], pubs[idx], precomp=precomp
+        )
     else:
         ok, xs = native.ecmul_double_batch(u1s[idx], u2s[idx], pubs[idx])
     for j, i in enumerate(idx):

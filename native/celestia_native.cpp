@@ -19,6 +19,7 @@
 #include <immintrin.h>
 #endif
 
+#include <array>
 #include <atomic>
 #include <thread>
 #include <vector>
@@ -1524,11 +1525,15 @@ int secp256k1_ecmul_double(const uint8_t* u1_be, const uint8_t* u2_be,
 // ks: 4 scalars of 32 bytes big-endian (|k1_G|, |k2_G|, |k1_Q|, |k2_Q|);
 // signs: 4 bytes, 1 = that component is negative (fold into the digit's
 // point sign).  Verification-only, like everything here.
-static int ecmul_double_glv_core(const uint8_t* ks, const uint8_t* signs,
-                                 const uint8_t* pub64, Jac& out) {
-    // pub64: uncompressed affine (x||y, 32+32 big-endian) — the caller
-    // decompresses once per distinct key (cached Python-side), saving the
-    // ~sqrt-sized field exponentiation every verify paid before.
+// Validate an uncompressed pubkey (x||y, 32+32 big-endian) and build the
+// odd-multiple table 1Q..15Q (w = 5) in Jacobian form.  Shared by the
+// per-call core (which keeps the table Jacobian) and the batched
+// precomputation path (which normalizes ALL tables of a stripe to affine
+// with one Montgomery inversion).  Returns 1 iff the key decodes onto
+// the curve.
+static int glv_build_qtab(const uint8_t* pub64, Jac qt[8]) {
+    // the caller decompresses once per distinct key (cached Python-side),
+    // saving the ~sqrt-sized field exponentiation every verify paid before
     Aff q;
     fe_from_bytes(q.x, pub64);
     fe_from_bytes(q.y, pub64 + 32);
@@ -1544,16 +1549,23 @@ static int ecmul_double_glv_core(const uint8_t* ks, const uint8_t* signs,
         fe_add(x3, x3, seven);
         if (fe_cmp(y2, x3) != 0) return 0;
     }
-    // odd multiples 1Q..15Q (w = 5), Jacobian (an affine normalization
-    // would cost a field inversion per call — more than it saves), plus
-    // the endomorphism image: phi(X:Y:Z) = (beta*X : Y : Z)
-    Jac qt[8], pqt[8];
     qt[0].x = q.x;
     qt[0].y = q.y;
     qt[0].z = {{1, 0, 0, 0}};
     Jac q2;
     jac_dbl(q2, qt[0]);
     for (int i = 1; i < 8; i++) jac_add(qt[i], qt[i - 1], q2);
+    return 1;
+}
+
+static int ecmul_double_glv_core(const uint8_t* ks, const uint8_t* signs,
+                                 const uint8_t* pub64, Jac& out) {
+    // odd multiples 1Q..15Q (w = 5), Jacobian (an affine normalization
+    // would cost a field inversion per call — more than it saves; the
+    // batched _pre path amortizes exactly that inversion), plus the
+    // endomorphism image: phi(X:Y:Z) = (beta*X : Y : Z)
+    Jac qt[8], pqt[8];
+    if (!glv_build_qtab(pub64, qt)) return 0;
     for (int i = 0; i < 8; i++) {
         fe_mul(pqt[i].x, qt[i].x, FE_BETA);
         pqt[i].y = qt[i].y;
@@ -1636,6 +1648,148 @@ void secp256k1_ecmul_double_glv_batch(const uint8_t* ks, const uint8_t* signs,
         for (size_t i = m; i-- > 0;) {
             Fe zinv, zi2;
             fe_mul(zinv, pref[i], acc);
+            fe_mul(acc, acc, rs[i].z);
+            fe_sqr(zi2, zinv);
+            Fe x;
+            fe_mul(x, rs[i].x, zi2);
+            fe_to_bytes(out_x + (size_t)idx[i] * 32, x);
+            ok[idx[i]] = 1;
+        }
+    };
+    if (nthreads == 1) {
+        work(0);
+    } else {
+        std::vector<std::thread> ts;
+        for (int t = 0; t < nthreads; t++) ts.emplace_back(work, t);
+        for (auto& th : ts) th.join();
+    }
+}
+
+// Digit loop of the GLV double-mult with the Q and phi(Q) tables ALREADY
+// normalized to affine: every table addition is the cheap mixed form
+// (~11 fe_mul vs ~16 for Jacobian-Jacobian), across all four tables.
+// The per-call field inversion that makes affine tables a loss in the
+// single-shot path is amortized by the caller over the whole stripe
+// (Montgomery's trick across all 8*live z-coordinates).
+static int ecmul_double_glv_core_aff(const uint8_t* ks, const uint8_t* signs,
+                                     const Aff qt[8], const Aff pqt[8],
+                                     Jac& out) {
+    int8_t d[4][260];
+    int len[4];
+    len[0] = wnaf_encode(ks + 0, 8, d[0]);
+    len[1] = wnaf_encode(ks + 32, 8, d[1]);
+    len[2] = wnaf_encode(ks + 64, 5, d[2]);
+    len[3] = wnaf_encode(ks + 96, 5, d[3]);
+    int maxlen = 0;
+    for (int j = 0; j < 4; j++)
+        if (len[j] > maxlen) maxlen = len[j];
+    Jac r = JAC_INF;
+    for (int i = maxlen - 1; i >= 0; i--) {
+        jac_dbl(r, r);
+        for (int j = 0; j < 4; j++) {
+            if (i >= len[j] || !d[j][i]) continue;
+            int8_t dg = d[j][i];
+            const Aff* tab = (j == 0)   ? G_TAB
+                             : (j == 1) ? PHI_G_TAB
+                             : (j == 2) ? qt
+                                        : pqt;
+            Aff a = tab[(dg > 0 ? dg : -dg) >> 1];
+            // component sign XOR digit sign picks the point's sign
+            if ((dg < 0) != (signs[j] != 0)) fe_neg(a.y, a.y);
+            jac_add_aff(r, r, a);
+        }
+    }
+    if (jac_is_inf(r)) return 0;
+    out = r;
+    return 1;
+}
+
+// Batched GLV double-multiplication WITH per-stripe table precomputation.
+// Same ABI as secp256k1_ecmul_double_glv_batch.  Three amortizations per
+// stripe: (1) the fixed-base G / phi(G) wNAF tables are the static w=8
+// precomputation shared by every call since secp_init; (2) phase A builds
+// every live verify's Jacobian Q-table, then ONE Montgomery inversion
+// over all 8*live z-coordinates normalizes them to affine, so (3) phase
+// B's digit loops run all-mixed-affine (~5 fe_mul cheaper per Q-table
+// addition, ~42 such additions per verify).  The final result
+// normalization is batched exactly like the legacy symbol.  Worth it
+// from roughly batch >= 4; below that the legacy symbol wins.
+void secp256k1_ecmul_double_glv_batch_pre(const uint8_t* ks,
+                                          const uint8_t* signs,
+                                          const uint8_t* pubs, int n,
+                                          uint8_t* out_x, uint8_t* ok,
+                                          int nthreads) {
+    secp_init();
+    if (nthreads <= 0) {
+        nthreads = (int)std::thread::hardware_concurrency();
+        if (nthreads <= 0) nthreads = 1;
+    }
+    if (nthreads > n) nthreads = n > 0 ? n : 1;
+    auto work = [&](int t) {
+        // phase A: Jacobian odd-multiple tables for the stripe's live
+        // verifies; all z-coordinates share one inversion
+        std::vector<std::array<Jac, 8>> jtabs;
+        std::vector<int> live;
+        for (int i = t; i < n; i += nthreads) {
+            std::array<Jac, 8> qt;
+            if (glv_build_qtab(pubs + (size_t)i * 64, qt.data())) {
+                jtabs.push_back(qt);
+                live.push_back(i);
+            } else {
+                ok[i] = 0;
+            }
+        }
+        size_t m = jtabs.size();
+        if (!m) return;
+        size_t nz = m * 8;
+        std::vector<Fe> pref(nz + 1);
+        pref[0] = {{1, 0, 0, 0}};
+        for (size_t i = 0; i < nz; i++)
+            fe_mul(pref[i + 1], pref[i], jtabs[i >> 3][i & 7].z);
+        Fe acc;
+        fe_inv(acc, pref[nz]);
+        std::vector<std::array<Aff, 8>> atabs(m), patabs(m);
+        for (size_t i = nz; i-- > 0;) {
+            const Jac& p = jtabs[i >> 3][i & 7];
+            Fe zinv, zi2, zi3;
+            fe_mul(zinv, pref[i], acc);
+            fe_mul(acc, acc, p.z);
+            fe_sqr(zi2, zinv);
+            fe_mul(zi3, zi2, zinv);
+            Aff& a = atabs[i >> 3][i & 7];
+            fe_mul(a.x, p.x, zi2);
+            fe_mul(a.y, p.y, zi3);
+            // endomorphism image on affine coords: phi(x, y) = (beta*x, y)
+            Aff& pa = patabs[i >> 3][i & 7];
+            fe_mul(pa.x, a.x, FE_BETA);
+            pa.y = a.y;
+        }
+        // phase B: all-mixed-affine digit loops; results stay Jacobian
+        // until the stripe's one result normalization
+        std::vector<Jac> rs;
+        std::vector<int> idx;
+        for (size_t s = 0; s < m; s++) {
+            int i = live[s];
+            Jac r;
+            if (ecmul_double_glv_core_aff(ks + (size_t)i * 128,
+                                          signs + (size_t)i * 4,
+                                          atabs[s].data(), patabs[s].data(),
+                                          r)) {
+                rs.push_back(r);
+                idx.push_back(i);
+            } else {
+                ok[i] = 0;
+            }
+        }
+        m = rs.size();
+        if (!m) return;
+        std::vector<Fe> rp(m + 1);
+        rp[0] = {{1, 0, 0, 0}};
+        for (size_t i = 0; i < m; i++) fe_mul(rp[i + 1], rp[i], rs[i].z);
+        fe_inv(acc, rp[m]);
+        for (size_t i = m; i-- > 0;) {
+            Fe zinv, zi2;
+            fe_mul(zinv, rp[i], acc);
             fe_mul(acc, acc, rs[i].z);
             fe_sqr(zi2, zinv);
             Fe x;

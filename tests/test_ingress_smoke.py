@@ -1,0 +1,26 @@
+"""In-process ingress smoke (the tier-1 twin of `make ingress-smoke` /
+tools/ingress_smoke.py, same contract as test_das_smoke): a gossip
+TxPush flood with a forged signature and a garbage blob buried
+mid-stream drains through ``check_txs_batch`` on a live node — one
+``verify_batch`` pass per chunk, replay admits nothing, block
+production takes the signer-grouped parallel FilterTxs leg and keeps
+every admitted tx, ``BroadcastBatch`` admits a follow-up batch over the
+wire, ``ingress.batch``/``ante.parallel`` spans land in the tracer and
+the ``celestia_tpu_ingress_*`` counters ride a parse-valid
+exposition."""
+
+import importlib.util
+from pathlib import Path
+
+_spec = importlib.util.spec_from_file_location(
+    "ingress_smoke",
+    Path(__file__).resolve().parent.parent / "tools" / "ingress_smoke.py",
+)
+ingress_smoke = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(ingress_smoke)
+
+
+def test_ingress_smoke_in_process(capsys):
+    assert ingress_smoke.main() == 0
+    out = capsys.readouterr().out
+    assert '"ingress_smoke": "ok"' in out

@@ -47,7 +47,13 @@ What counts as a headline metric (see BASELINE.md for meanings):
   ``fairness_index`` — judged against an ABSOLUTE 0.8 FLOOR on the
   latest round only (the QoS fairness contract: an honest crowd must
   see a near-uniform served distribution; a lucky 0.99 round must not
-  turn every later 0.95 into a failure, so no best-so-far trend).
+  turn every later 0.95 into a failure, so no best-so-far trend),
+* ``extras.tx_ingress`` (the batched admission plane): every
+  ``*_tx_per_s`` sustained-throughput figure and the FilterTxs
+  ``*_speedup`` (HIGHER is better), plus the ``*_ms`` /
+  ``*_us_per_sig`` latency figures (lower).  Names carry the batch
+  size and cache regime (``check_b512_cold_tx_per_s``), so cold and
+  warm drains at different batch sizes never cross-compare.
 
 Rounds whose ``parsed`` is null (a crashed bench run) contribute no
 values; they are counted and reported, never treated as zeros.
@@ -227,6 +233,19 @@ def _flat_headlines(parsed: dict):
                         "_p50_" in mk or "_p99_" in mk
                     ):
                         yield f"swarm.{leg}.{mk}", float(mv), False
+        elif key == "tx_ingress" and isinstance(val, dict):
+            # the batched admission plane: sustained tx/s (HIGHER) at
+            # each batch size/regime, the FilterTxs speedup over the
+            # sequential leg (HIGHER), and the latency/µs-per-sig
+            # figures (lower).  Names carry batch size and regime, so
+            # a cold batch-1 round never cross-compares a warm batch-512
+            for mk, mv in sorted(val.items()):
+                if isinstance(mv, bool) or not isinstance(mv, (int, float)):
+                    continue
+                if mk.endswith("_tx_per_s") or mk.endswith("_speedup"):
+                    yield f"tx_ingress.{mk}", float(mv), True
+                elif mk.endswith("_ms") or mk.endswith("_us_per_sig"):
+                    yield f"tx_ingress.{mk}", float(mv), False
         elif key == "lint_stats" and isinstance(val, dict):
             # celint whole-tree wall time: the R6 whole-program pass is
             # the only tier-1 gate whose cost grows with the TREE, so
